@@ -45,6 +45,32 @@ _DEFAULT_METRIC = {
 }
 
 
+def _check_range(param, value):
+    """Enforce the schema's declared constraint (reference CHECK failures).
+
+    Constraint strings use a small grammar: "> 0", ">= 0.0",
+    "0.0 < x <= 1.0", "0.0 <= x < 1.0".
+    """
+    spec = param.check
+    if not spec or not isinstance(value, (int, float)) or isinstance(value, bool):
+        return
+    ops = {"<": float.__lt__, "<=": float.__le__,
+           ">": float.__gt__, ">=": float.__ge__}
+    v = float(value)
+    parts = spec.split()
+    ok = True
+    if "x" in parts:
+        # "LO <op> x <op> HI"
+        lo, op1, _, op2, hi = parts
+        ok = ops[op1](float(lo), v) and ops[op2](v, float(hi))
+    else:
+        op, bound = parts
+        ok = ops[op](v, float(bound))
+    if not ok:
+        raise ValueError(
+            f"parameter {param.name}={value} violates constraint {spec}")
+
+
 def resolve_alias(key: str) -> str:
     """Map a parameter alias to its canonical name (unknown keys pass through)."""
     k = key.strip().lower()
@@ -93,9 +119,21 @@ class Config:
         norm = normalize_params(params)
         for key, value in norm.items():
             if key in PARAM_BY_NAME:
+                _check_range(PARAM_BY_NAME[key], value)
                 setattr(self, key, value)
             else:
                 self.extra[key] = value
+        if "seed" in norm and norm["seed"]:
+            # master seed deterministically derives the sub-seeds that were
+            # not set explicitly (reference Config behaviour for `seed`)
+            from .utils.random import derive_seeds
+            derived = derive_seeds(int(norm["seed"]))
+            for key, sub in (("data_random_seed", "data"),
+                             ("feature_fraction_seed", "feature_fraction"),
+                             ("bagging_seed", "bagging"),
+                             ("drop_seed", "drop")):
+                if key not in norm:
+                    setattr(self, key, derived[sub] & 0x7FFFFFFF)
         self._resolve_enums()
         self._check_conflicts()
         return self

@@ -512,6 +512,7 @@ class BinnedDataset:
         ds.metadata.weights = state["weights"]
         ds.metadata.query_boundaries = state["query_boundaries"]
         ds.metadata.init_score = state["init_score"]
+        ds.metadata._update_query_weights()
         ds._build_feature_lookups(None)
         ds.monotone_constraints = state["monotone"]
         ds.feature_penalty = state["penalty"]

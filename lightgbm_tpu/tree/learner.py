@@ -1,0 +1,323 @@
+"""Serial (single-device) leaf-wise tree learner.
+
+TPU-native re-design of the reference ``SerialTreeLearner``
+(``src/treelearner/serial_tree_learner.cpp:157-221``): the host drives the
+best-first loop and owns the tree bookkeeping; the device owns the binned
+matrix, gradients, leaf index partition, histogram construction and the
+best-split scan.  Per split the device work is
+
+  1. stable partition of the split leaf's (padded) index window,
+  2. histogram of the *smaller* child (one-hot matmul over its rows),
+  3. larger child = parent - smaller (histogram subtraction trick,
+     serial_tree_learner.cpp:508-513),
+  4. fused best-split scan for both children,
+
+and the only host<->device synchronisation is fetching the two children's
+small best-split records.  Leaf windows are padded to power-of-two buckets so
+the number of compiled programs stays ~log2(N).
+
+Monotone-constraint midpoint propagation mirrors
+serial_tree_learner.cpp:765-776; forced splits (JSON BFS) mirror
+``ForceSplits`` (serial_tree_learner.cpp:546-701).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.histogram import build_histogram, bucket_size, subtract_histogram
+from ..ops.partition import apply_leaf_outputs, partition_leaf
+from ..ops.split import SplitContext
+from ..utils.log import log_debug, log_warning
+from .tree import Tree, construct_bitset
+
+
+@functools.partial(jax.jit, static_argnames=("m",))
+def _slice_window(buffer, begin, m):
+    return jax.lax.dynamic_slice(buffer, (begin,), (m,))
+
+
+@jax.jit
+def _write_window(buffer, window, begin):
+    return jax.lax.dynamic_update_slice(buffer, window, (begin,))
+
+
+@jax.jit
+def _hist_totals(hist):
+    """Leaf totals from any single group's slots (every row lands in exactly
+    one slot per group)."""
+    return hist[0].sum(axis=0)
+
+
+class _LeafInfo:
+    __slots__ = ("begin", "count", "total", "cmin", "cmax", "hist", "best",
+                 "depth", "output")
+
+    def __init__(self, begin, count, total, cmin, cmax, hist, depth, output):
+        self.begin = begin
+        self.count = count
+        self.total = total          # (g, h, c) floats on host
+        self.cmin = cmin
+        self.cmax = cmax
+        self.hist = hist            # device (G, 256, 3) or None
+        self.best = None            # device dict from find_best
+        self.depth = depth
+        self.output = output        # current leaf output value
+
+
+class SerialTreeLearner:
+    """Grows one tree from (grad, hess) device arrays."""
+
+    def __init__(self, config, dataset):
+        self.config = config
+        self.dataset = dataset
+        self.binned = jnp.asarray(dataset.binned)
+        self.num_data = dataset.num_data
+        self.n_pad = bucket_size(max(self.num_data, 1))
+        self.ctx = SplitContext(dataset, config)
+        self._full_indices = jnp.arange(self.n_pad, dtype=jnp.int32)
+        self._rng = np.random.RandomState(
+            (config.feature_fraction_seed if config.feature_fraction_seed
+             else config.seed + 2) & 0x7FFFFFFF)
+        self.forced_splits = None   # parsed forced-split JSON (dict) or None
+
+    # ------------------------------------------------------------------
+    def _feature_mask(self) -> jnp.ndarray:
+        nf = self.dataset.num_features
+        frac = self.config.feature_fraction
+        if frac >= 1.0 or nf <= 1:
+            return jnp.ones(nf, dtype=bool)
+        k = max(1, int(math.ceil(nf * frac)))
+        chosen = self._rng.choice(nf, size=k, replace=False)
+        mask = np.zeros(nf, dtype=bool)
+        mask[chosen] = True
+        return jnp.asarray(mask)
+
+    def _window(self, begin: int, count: int):
+        """(slice_begin, static size M, start offset) for a leaf region."""
+        m = min(bucket_size(max(count, 1)), self.n_pad)
+        b = min(begin, self.n_pad - m)
+        return b, m, begin - b
+
+    # ------------------------------------------------------------------
+    def train(self, grad, hess, indices_buffer=None, data_count=None,
+              feature_mask=None) -> Tree:
+        """Grow one tree.  ``indices_buffer`` is a device (n_pad,) int32
+        permutation whose first ``data_count`` entries are the usable rows
+        (bagging); defaults to all rows."""
+        cfg = self.config
+        if indices_buffer is None:
+            indices_buffer = self._full_indices
+            data_count = self.num_data
+        self.buffer = indices_buffer
+        if feature_mask is None:
+            feature_mask = self._feature_mask()
+
+        tree = Tree(cfg.num_leaves)
+        leaves: Dict[int, _LeafInfo] = {}
+
+        if self.dataset.num_groups == 0 or self.dataset.num_features == 0:
+            # no usable features: single-leaf tree from the root sums
+            g, h = map(float, (jnp.sum(grad), jnp.sum(hess)))
+            root = _LeafInfo(0, data_count, np.asarray([g, h, data_count]),
+                             -math.inf, math.inf, None, 0,
+                             self._leaf_output(g, h))
+            tree.leaf_value[0] = root.output
+            leaves[0] = root
+            self.leaves = leaves
+            return tree
+
+        # root
+        b, m, start = self._window(0, data_count)
+        win = _slice_window(self.buffer, b, m)
+        hist = build_histogram(self.binned, grad, hess, win, data_count, start)
+        total = np.asarray(_hist_totals(hist), np.float64)
+        root = _LeafInfo(0, data_count, total, -math.inf, math.inf, hist, 0,
+                         self._leaf_output(total[0], total[1]))
+        tree.leaf_value[0] = root.output
+        leaves[0] = root
+        self._schedule_find_best(root, feature_mask)
+
+        forced_queue = self._init_forced(tree)
+
+        for _ in range(cfg.num_leaves - 1):
+            best_leaf, best = self._pick_best_leaf(leaves, forced_queue)
+            if best_leaf is None:
+                break
+            self._apply_split(tree, leaves, best_leaf, best, grad, hess,
+                              feature_mask)
+
+        self.leaves = leaves
+        return tree
+
+    # ------------------------------------------------------------------
+    def _leaf_output(self, sum_g, sum_h):
+        cfg = self.config
+        l1, l2 = cfg.lambda_l1, cfg.lambda_l2
+        reg = max(abs(sum_g) - l1, 0.0) * (1 if sum_g >= 0 else -1) \
+            if l1 > 0 else sum_g
+        out = -reg / (sum_h + l2) if (sum_h + l2) != 0 else 0.0
+        mds = cfg.max_delta_step
+        if mds > 0 and abs(out) > mds:
+            out = math.copysign(mds, out)
+        return out
+
+    def _splittable(self, info: _LeafInfo) -> bool:
+        cfg = self.config
+        if info.count <= 2 * cfg.min_data_in_leaf:
+            return False
+        if info.total[1] <= 2 * cfg.min_sum_hessian_in_leaf:
+            return False
+        if cfg.max_depth > 0 and info.depth >= cfg.max_depth:
+            return False
+        return True
+
+    def _schedule_find_best(self, info: _LeafInfo, feature_mask):
+        if not self._splittable(info):
+            info.best = None
+            return
+        flat = info.hist.reshape(-1, 3)
+        info.best = self.ctx.find_best(
+            flat, info.total, (info.cmin, info.cmax), feature_mask)
+
+    def _pick_best_leaf(self, leaves, forced_queue):
+        best_leaf, best_rec, best_gain = None, None, 0.0
+        for leaf in sorted(leaves):
+            info = leaves[leaf]
+            if info.best is None:
+                continue
+            info.best = jax.device_get(info.best)
+            gain = float(info.best["gain"])
+            if gain > best_gain:
+                best_leaf, best_rec, best_gain = leaf, info.best, gain
+        if best_leaf is None:
+            return None, None
+        return best_leaf, best_rec
+
+    # ------------------------------------------------------------------
+    def _apply_split(self, tree, leaves, leaf, best, grad, hess, feature_mask,
+                     forced=False):
+        ds = self.dataset
+        cfg = self.config
+        info = leaves[leaf]
+        f = int(best["feature"])
+        real_f = ds.used_features[f]
+        mapper = ds.bin_mappers[real_f]
+        group = int(ds.f_group[f])
+        offset = int(ds.f_offset[f])
+        nb = int(ds.f_num_bin[f])
+        default_bin = int(ds.f_default_bin[f])
+        width = nb - (1 if default_bin == 0 else 0)
+        missing = int(ds.f_missing_type[f])
+        is_cat = bool(best["is_cat"])
+        threshold = int(best["threshold"])
+        default_left = bool(best["default_left"])
+        cat_member = np.asarray(best["cat_member"], bool)
+
+        left_sum = np.asarray(best["left_sum"], np.float64)
+        right_sum = np.asarray(best["right_sum"], np.float64)
+        left_out = float(best["left_out"])
+        right_out = float(best["right_out"])
+        gain = float(best["gain"])
+
+        if is_cat:
+            member_bins = [int(bb) for bb in np.nonzero(cat_member)[0]
+                           if bb < nb]
+            bitset_inner = construct_bitset(member_bins)
+            cats = [int(mapper.bin_2_categorical[bb]) for bb in member_bins
+                    if bb < len(mapper.bin_2_categorical)
+                    and mapper.bin_2_categorical[bb] >= 0]
+            bitset = construct_bitset(cats)
+            right_leaf = tree.split_categorical(
+                leaf, f, real_f, bitset_inner, bitset, left_out, right_out,
+                int(left_sum[2]), int(right_sum[2]), gain, missing)
+        else:
+            threshold_double = mapper.bin_to_value(threshold)
+            right_leaf = tree.split(
+                leaf, f, real_f, threshold, threshold_double, left_out,
+                right_out, int(left_sum[2]), int(right_sum[2]), gain,
+                missing, default_left)
+
+        # device partition (no sync needed: left count comes from SplitInfo)
+        b, m, start = self._window(info.begin, info.count)
+        win = _slice_window(self.buffer, b, m)
+        new_win, _ = partition_leaf(
+            self.binned, win, info.count, group=group, offset=offset,
+            width=width, default_bin=default_bin, num_bin=nb, missing=missing,
+            threshold=threshold, default_left=default_left, is_cat=is_cat,
+            cat_member=cat_member, start=start)
+        self.buffer = _write_window(self.buffer, new_win, b)
+
+        lc, rc = int(left_sum[2]), int(right_sum[2])
+        cmin, cmax = info.cmin, info.cmax
+        lmin, lmax, rmin, rmax = cmin, cmax, cmin, cmax
+        mono = int(ds.monotone_constraints[f])
+        if mono != 0 and not is_cat:
+            mid = (left_out + right_out) / 2.0
+            if mono > 0:
+                lmax, rmin = mid, mid
+            else:
+                lmin, rmax = mid, mid
+
+        left_info = _LeafInfo(info.begin, lc, left_sum, lmin, lmax, None,
+                              info.depth + 1, left_out)
+        right_info = _LeafInfo(info.begin + lc, rc, right_sum, rmin, rmax,
+                               None, info.depth + 1, right_out)
+        leaves[leaf] = left_info
+        leaves[right_leaf] = right_info
+
+        # histogram: build the smaller child, subtract for the larger
+        small, large = ((left_info, right_info) if lc <= rc
+                        else (right_info, left_info))
+        need = self._splittable(small) or self._splittable(large)
+        if need:
+            sb, sm, sstart = self._window(small.begin, small.count)
+            swin = _slice_window(self.buffer, sb, sm)
+            small.hist = build_histogram(self.binned, grad, hess, swin,
+                                         small.count, sstart)
+            large.hist = subtract_histogram(info.hist, small.hist)
+        info.hist = None
+        self._schedule_find_best(left_info, feature_mask)
+        self._schedule_find_best(right_info, feature_mask)
+        return right_leaf
+
+    # ------------------------------------------------------------------
+    # forced splits (reference ForceSplits, serial_tree_learner.cpp:546-701)
+    def _init_forced(self, tree):
+        if not self.forced_splits:
+            return []
+        log_warning("forcedsplits are not supported by the TPU learner yet; "
+                    "ignoring forced split file")
+        return []
+
+    # ------------------------------------------------------------------
+    def leaf_regions(self):
+        """[(leaf, begin, count)] of the final partition, by position."""
+        return sorted(((leaf, li.begin, li.count)
+                       for leaf, li in self.leaves.items()),
+                      key=lambda t: t[1])
+
+    def update_score(self, score, tree: Tree, multiplier: float = 1.0):
+        """Train-score update via leaf partitions (ScoreUpdater::AddScore).
+        Only positions inside the bagged region get updates; out-of-bag rows
+        are the boosting layer's job (gbdt.cpp:451-471)."""
+        regions = self.leaf_regions()
+        data_count = sum(r[2] for r in regions)
+        begins = jnp.asarray([r[1] for r in regions], jnp.int32)
+        values = jnp.asarray(
+            [tree.leaf_value[r[0]] * multiplier for r in regions], jnp.float32)
+        idx = self.buffer[:self.num_data] if self.n_pad != self.num_data \
+            else self.buffer
+        return apply_leaf_outputs(score, idx, begins, values,
+                                  jnp.asarray(data_count, jnp.int32))
+
+    def leaf_indices_host(self) -> Dict[int, np.ndarray]:
+        """Per-leaf raw row indices (host); used by RenewTreeOutput."""
+        buf = np.asarray(self.buffer[:self.num_data])
+        return {leaf: buf[b:b + c] for leaf, b, c in self.leaf_regions()}

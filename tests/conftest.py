@@ -1,0 +1,62 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+The reference has no mockable network backend (SURVEY.md §4); here every
+distributed mode is exercised deterministically in-process by forcing the CPU
+platform with 8 virtual devices.  Must run before the first jax import.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"   # tests always run on the CPU mesh
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+REFERENCE_EXAMPLES = "/root/reference/examples"
+
+
+def load_svmlight(path, n_features=None):
+    """Tiny LibSVM reader for the lambdarank fixtures."""
+    labels, rows, cols, vals = [], [], [], []
+    with open(path) as fh:
+        for i, line in enumerate(fh):
+            parts = line.strip().split()
+            labels.append(float(parts[0]))
+            for tok in parts[1:]:
+                c, v = tok.split(":")
+                rows.append(i)
+                cols.append(int(c))
+                vals.append(float(v))
+    n = len(labels)
+    nf = (max(cols) + 1) if n_features is None else n_features
+    x = np.zeros((n, nf), np.float64)
+    x[rows, cols] = vals
+    return x, np.asarray(labels, np.float64)
+
+
+@pytest.fixture(scope="session")
+def regression_data():
+    d = np.loadtxt(f"{REFERENCE_EXAMPLES}/regression/regression.train")
+    dt = np.loadtxt(f"{REFERENCE_EXAMPLES}/regression/regression.test")
+    return d[:, 1:], d[:, 0], dt[:, 1:], dt[:, 0]
+
+
+@pytest.fixture(scope="session")
+def binary_data():
+    d = np.loadtxt(f"{REFERENCE_EXAMPLES}/binary_classification/binary.train")
+    dt = np.loadtxt(f"{REFERENCE_EXAMPLES}/binary_classification/binary.test")
+    return d[:, 1:], d[:, 0], dt[:, 1:], dt[:, 0]
+
+
+@pytest.fixture(scope="session")
+def rank_data():
+    base = f"{REFERENCE_EXAMPLES}/lambdarank"
+    x, y = load_svmlight(f"{base}/rank.train")
+    xt, yt = load_svmlight(f"{base}/rank.test", n_features=x.shape[1])
+    q = np.loadtxt(f"{base}/rank.train.query").astype(np.int64)
+    qt = np.loadtxt(f"{base}/rank.test.query").astype(np.int64)
+    return x, y, q, xt, yt, qt
