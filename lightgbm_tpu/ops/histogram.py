@@ -30,14 +30,29 @@ _CHUNK = 8192
 def _chunk_histogram(bins_u8: jnp.ndarray, gh: jnp.ndarray) -> jnp.ndarray:
     """(C, G) uint8 bins x (C, 3) [g, h, 1] -> (G, 256, 3) partial sums.
 
-    Precision HIGHEST keeps the gradient operand in full float32 on the MXU
-    (TPU default would round it to bfloat16; the one-hot operand is exact in
-    any dtype, but 0.4%-level gradient rounding visibly moves split gains).
+    TPU: one-hot matmul on the MXU.  Precision HIGHEST keeps the gradient
+    operand in full float32 (TPU default would round it to bfloat16; the
+    one-hot operand is exact in any dtype, but 0.4%-level gradient rounding
+    visibly moves split gains).
+
+    CPU (tests / virtual mesh): XLA CPU would materialise the one-hot and
+    run the f32 matmul through the slow 6-pass emulation, so use a
+    scatter-add instead — same result, ~100x faster there.
     """
-    oh = jax.nn.one_hot(bins_u8, 256, dtype=jnp.float32)      # (C, G, 256)
-    return jnp.einsum("cgb,ck->gbk", oh, gh,
-                      precision=jax.lax.Precision.HIGHEST,
-                      preferred_element_type=jnp.float32)
+    if jax.default_backend() == "tpu":
+        oh = jax.nn.one_hot(bins_u8, 256, dtype=jnp.float32)  # (C, G, 256)
+        return jnp.einsum("cgb,ck->gbk", oh, gh,
+                          precision=jax.lax.Precision.HIGHEST,
+                          preferred_element_type=jnp.float32)
+    g = bins_u8.shape[1]
+    flat_idx = (jnp.arange(g, dtype=jnp.int32)[None, :] * 256
+                + bins_u8.astype(jnp.int32))                  # (C, G)
+    updates = jnp.broadcast_to(gh[:, None, :],
+                               (gh.shape[0], g, 3))           # (C, G, 3)
+    hist = jnp.zeros((g * 256, 3), jnp.float32)
+    hist = hist.at[flat_idx.reshape(-1)].add(
+        updates.reshape(-1, 3))
+    return hist.reshape(g, 256, 3)
 
 
 @functools.partial(jax.jit, static_argnames=("num_chunks",))

@@ -41,6 +41,12 @@ K_EPSILON = 1e-15
 NEG_INF = -1e30
 
 
+# indices into the packed best-split vector returned by find_best
+(F_GAIN, F_FEATURE, F_THRESHOLD, F_DEFAULT_LEFT, F_IS_CAT,
+ F_LEFT_G, F_LEFT_H, F_LEFT_C, F_RIGHT_G, F_RIGHT_H, F_RIGHT_C,
+ F_LEFT_OUT, F_RIGHT_OUT) = range(13)
+
+
 class SplitHyper(NamedTuple):
     """Traced hyper-parameters (no recompilation when values change)."""
     lambda_l1: jnp.ndarray
@@ -114,6 +120,8 @@ class SplitContext:
         slot = np.where(valid, slot, 0)
 
         self.num_features = nf
+        self.has_categorical = bool(
+            np.asarray(dataset.f_is_categorical).any())
         self.slot_idx = jnp.asarray(slot, jnp.int32)
         self.valid_nondefault = jnp.asarray(valid)
         self.f_num_bin = jnp.asarray(nb)
@@ -126,19 +134,21 @@ class SplitContext:
 
     def find_best(self, flat_hist, total, constraint, feature_mask):
         """flat_hist (G*256, 3); total (3,) [g,h,c]; constraint (2,) [min,max];
-        feature_mask (F,) bool.  Returns device scalars dict (fetch async)."""
+        feature_mask (F,) bool.  Returns (packed (13,) f32 — see F_* indices —
+        and cat-member mask (256,) bool) as device values (fetch async)."""
         return _find_best_split(
             flat_hist, jnp.asarray(total, jnp.float32),
             jnp.asarray(constraint, jnp.float32), feature_mask,
             self.slot_idx, self.valid_nondefault, self.f_num_bin,
             self.f_default_bin, self.f_missing, self.f_is_cat, self.f_mono,
-            self.f_penalty, self.hyper)
+            self.f_penalty, self.hyper, self.has_categorical)
 
 
-@functools.partial(jax.jit, donate_argnums=())
+@functools.partial(jax.jit, static_argnames=("has_cat",))
 def _find_best_split(flat_hist, total, constraint, feature_mask,
                      slot_idx, valid_nd, f_num_bin, f_default_bin, f_missing,
-                     f_is_cat, f_mono, f_penalty, hp: SplitHyper):
+                     f_is_cat, f_mono, f_penalty, hp: SplitHyper,
+                     has_cat: bool = True):
     tg, th, tc = total[0], total[1] + 2.0 * K_EPSILON, total[2]
     cmin, cmax = constraint[0], constraint[1]
     l1, l2, mds = hp.lambda_l1, hp.lambda_l2, hp.max_delta_step
@@ -209,8 +219,32 @@ def _find_best_split(flat_hist, total, constraint, feature_mask,
         lefts.reshape(lefts.shape[0], 512, 3), num_arg[:, None, None], 1)[:, 0]
 
     # =====================================================================
-    # categorical
+    # categorical (statically skipped for all-numerical datasets)
     # =====================================================================
+    nf = fh.shape[0]
+    if not has_cat:
+        is_cat = f_is_cat == 1
+        feat_gain = (num_best_gain - min_gain_shift) * f_penalty
+        feat_gain = jnp.where(feature_mask & (f_num_bin > 1), feat_gain,
+                              NEG_INF)
+        best_f = jnp.argmax(feat_gain)
+        left = num_left[best_f]
+        lg, lh, lc = left[0], left[1] + K_EPSILON, left[2]
+        rg, rh, rc = tg - lg, th - lh, tc - lc
+        left_out = jnp.clip(_calc_output(lg, lh, l1, l2, mds), cmin, cmax)
+        right_out = jnp.clip(_calc_output(rg, rh, l1, l2, mds), cmin, cmax)
+        packed = jnp.stack([
+            feat_gain[best_f],
+            best_f.astype(jnp.float32),
+            num_thr[best_f].astype(jnp.float32),
+            num_dl[best_f].astype(jnp.float32),
+            jnp.zeros((), jnp.float32),
+            lg, left[1], lc,
+            rg, th - 2.0 * K_EPSILON - left[1], rc,
+            left_out, right_out,
+        ])
+        return packed, jnp.zeros(256, bool)
+
     cnt = fh[..., 2]
     used_bin_mask = b < (f_num_bin[:, None] - 1 + (miss == 0))  # exclude NaN bin
     # one-hot mode: left = single bin t (regular l2)
@@ -302,18 +336,18 @@ def _find_best_split(flat_hist, total, constraint, feature_mask,
     left_out = jnp.clip(_calc_output(lg, lh, l1, use_l2, mds), cmin, cmax)
     right_out = jnp.clip(_calc_output(rg, rh, l1, use_l2, mds), cmin, cmax)
 
-    return {
-        "gain": best_gain,
-        "feature": best_f.astype(jnp.int32),
-        "threshold": num_thr[best_f].astype(jnp.int32),
-        "default_left": num_dl[best_f],
-        "is_cat": best_is_cat,
-        "cat_member": cat_member[best_f],
-        "left_sum": jnp.stack([lg, left[1], lc]),
-        "right_sum": jnp.stack([rg, th - 2.0 * K_EPSILON - left[1], rc]),
-        "left_out": left_out,
-        "right_out": right_out,
-    }
+    # single packed vector: one host fetch per leaf instead of ten
+    packed = jnp.stack([
+        best_gain,
+        best_f.astype(jnp.float32),
+        num_thr[best_f].astype(jnp.float32),
+        num_dl[best_f].astype(jnp.float32),
+        best_is_cat.astype(jnp.float32),
+        lg, left[1], lc,
+        rg, th - 2.0 * K_EPSILON - left[1], rc,
+        left_out, right_out,
+    ])
+    return packed, cat_member[best_f]
 
 
 def find_best_split(ctx: SplitContext, flat_hist, total, constraint,

@@ -1,0 +1,26 @@
+"""Distributed tree learners + collective verbs.
+
+Factory mirrors ``TreeLearner::CreateTreeLearner``
+(``src/treelearner/tree_learner.cpp:9-33``): (tree_learner, device) picks
+the implementation.  On TPU all learners are device-resident; the parallel
+variants add mesh-axis collectives (see ``network.py``).
+"""
+
+from ..tree.learner import SerialTreeLearner
+
+
+def create_tree_learner(config, dataset):
+    kind = config.tree_learner
+    if kind == "serial" or config.num_machines <= 1:
+        from .data_parallel import maybe_sharded_learner
+        return maybe_sharded_learner(config, dataset)
+    if kind == "feature":
+        from .feature_parallel import FeatureParallelTreeLearner
+        return FeatureParallelTreeLearner(config, dataset)
+    if kind == "data":
+        from .data_parallel import DataParallelTreeLearner
+        return DataParallelTreeLearner(config, dataset)
+    if kind == "voting":
+        from .voting_parallel import VotingParallelTreeLearner
+        return VotingParallelTreeLearner(config, dataset)
+    raise ValueError(f"unknown tree_learner: {kind}")

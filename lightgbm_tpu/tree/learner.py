@@ -31,9 +31,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.histogram import build_histogram, bucket_size, subtract_histogram
-from ..ops.partition import apply_leaf_outputs, partition_leaf
-from ..ops.split import SplitContext
+from ..ops.histogram import (_gather_rows, _histogram_scan, bucket_size,
+                             _CHUNK, subtract_histogram)
+from ..ops.partition import _partition_kernel, apply_leaf_outputs
+from ..ops.split import (F_DEFAULT_LEFT, F_FEATURE, F_GAIN, F_IS_CAT,
+                         F_LEFT_C, F_LEFT_G, F_LEFT_H, F_LEFT_OUT,
+                         F_RIGHT_C, F_RIGHT_G, F_RIGHT_H, F_RIGHT_OUT,
+                         F_THRESHOLD, SplitContext)
 from ..utils.log import log_debug, log_warning
 from .tree import Tree, construct_bitset
 
@@ -43,9 +47,26 @@ def _slice_window(buffer, begin, m):
     return jax.lax.dynamic_slice(buffer, (begin,), (m,))
 
 
-@jax.jit
-def _write_window(buffer, window, begin):
-    return jax.lax.dynamic_update_slice(buffer, window, (begin,))
+@functools.partial(jax.jit, static_argnames=("m", "num_chunks"))
+def _window_histogram(binned, grad, hess, buffer, begin, start, count, m,
+                      num_chunks):
+    """Fused slice + gather + histogram for one leaf window."""
+    win = jax.lax.dynamic_slice(buffer, (begin,), (m,))
+    bins, gh = _gather_rows(binned, grad, hess, win, start, count)
+    return _histogram_scan(bins, gh, num_chunks)
+
+
+@functools.partial(jax.jit, static_argnames=("m",), donate_argnums=(1,))
+def _window_partition(binned, buffer, begin, m, start, count, group, offset,
+                      width, default_bin, num_bin, missing, threshold,
+                      default_left, is_cat, cat_member):
+    """Fused slice + stable partition + write-back (buffer donated)."""
+    win = jax.lax.dynamic_slice(buffer, (begin,), (m,))
+    new_win, _ = _partition_kernel(binned, win, start, count, group, offset,
+                                   width, default_bin, num_bin, missing,
+                                   threshold, default_left, is_cat,
+                                   cat_member)
+    return jax.lax.dynamic_update_slice(buffer, new_win, (begin,))
 
 
 @jax.jit
@@ -105,6 +126,14 @@ class SerialTreeLearner:
         b = min(begin, self.n_pad - m)
         return b, m, begin - b
 
+    def _leaf_histogram(self, grad, hess, begin: int, count: int):
+        b, m, start = self._window(begin, count)
+        num_chunks = m // _CHUNK if (m > _CHUNK and m % _CHUNK == 0) else 1
+        return _window_histogram(self.binned, grad, hess, self.buffer,
+                                 jnp.asarray(b, jnp.int32),
+                                 jnp.asarray(start, jnp.int32),
+                                 jnp.asarray(count, jnp.int32), m, num_chunks)
+
     # ------------------------------------------------------------------
     def train(self, grad, hess, indices_buffer=None, data_count=None,
               feature_mask=None) -> Tree:
@@ -115,7 +144,9 @@ class SerialTreeLearner:
         if indices_buffer is None:
             indices_buffer = self._full_indices
             data_count = self.num_data
-        self.buffer = indices_buffer
+        # private copy: the partition kernel donates (in-place updates) the
+        # buffer, and the caller's bagging buffer must survive across trees
+        self.buffer = jnp.array(indices_buffer, copy=True)
         if feature_mask is None:
             feature_mask = self._feature_mask()
 
@@ -134,9 +165,7 @@ class SerialTreeLearner:
             return tree
 
         # root
-        b, m, start = self._window(0, data_count)
-        win = _slice_window(self.buffer, b, m)
-        hist = build_histogram(self.binned, grad, hess, win, data_count, start)
+        hist = self._leaf_histogram(grad, hess, 0, data_count)
         total = np.asarray(_hist_totals(hist), np.float64)
         root = _LeafInfo(0, data_count, total, -math.inf, math.inf, hist, 0,
                          self._leaf_output(total[0], total[1]))
@@ -192,8 +221,10 @@ class SerialTreeLearner:
             info = leaves[leaf]
             if info.best is None:
                 continue
-            info.best = jax.device_get(info.best)
-            gain = float(info.best["gain"])
+            if not isinstance(info.best[0], np.ndarray):
+                info.best = (np.asarray(info.best[0]),
+                             info.best[1])   # mask fetched lazily if needed
+            gain = float(info.best[0][F_GAIN])
             if gain > best_gain:
                 best_leaf, best_rec, best_gain = leaf, info.best, gain
         if best_leaf is None:
@@ -206,7 +237,8 @@ class SerialTreeLearner:
         ds = self.dataset
         cfg = self.config
         info = leaves[leaf]
-        f = int(best["feature"])
+        vec, mask_dev = best
+        f = int(vec[F_FEATURE])
         real_f = ds.used_features[f]
         mapper = ds.bin_mappers[real_f]
         group = int(ds.f_group[f])
@@ -215,16 +247,19 @@ class SerialTreeLearner:
         default_bin = int(ds.f_default_bin[f])
         width = nb - (1 if default_bin == 0 else 0)
         missing = int(ds.f_missing_type[f])
-        is_cat = bool(best["is_cat"])
-        threshold = int(best["threshold"])
-        default_left = bool(best["default_left"])
-        cat_member = np.asarray(best["cat_member"], bool)
+        is_cat = bool(vec[F_IS_CAT])
+        threshold = int(vec[F_THRESHOLD])
+        default_left = bool(vec[F_DEFAULT_LEFT])
+        cat_member = (np.asarray(mask_dev, bool) if is_cat
+                      else np.zeros(256, bool))
 
-        left_sum = np.asarray(best["left_sum"], np.float64)
-        right_sum = np.asarray(best["right_sum"], np.float64)
-        left_out = float(best["left_out"])
-        right_out = float(best["right_out"])
-        gain = float(best["gain"])
+        left_sum = np.asarray([vec[F_LEFT_G], vec[F_LEFT_H], vec[F_LEFT_C]],
+                              np.float64)
+        right_sum = np.asarray([vec[F_RIGHT_G], vec[F_RIGHT_H],
+                                vec[F_RIGHT_C]], np.float64)
+        left_out = float(vec[F_LEFT_OUT])
+        right_out = float(vec[F_RIGHT_OUT])
+        gain = float(vec[F_GAIN])
 
         if is_cat:
             member_bins = [int(bb) for bb in np.nonzero(cat_member)[0]
@@ -246,13 +281,12 @@ class SerialTreeLearner:
 
         # device partition (no sync needed: left count comes from SplitInfo)
         b, m, start = self._window(info.begin, info.count)
-        win = _slice_window(self.buffer, b, m)
-        new_win, _ = partition_leaf(
-            self.binned, win, info.count, group=group, offset=offset,
-            width=width, default_bin=default_bin, num_bin=nb, missing=missing,
-            threshold=threshold, default_left=default_left, is_cat=is_cat,
-            cat_member=cat_member, start=start)
-        self.buffer = _write_window(self.buffer, new_win, b)
+        i32 = lambda v: jnp.asarray(v, jnp.int32)
+        self.buffer = _window_partition(
+            self.binned, self.buffer, i32(b), m, i32(start), i32(info.count),
+            i32(group), i32(offset), i32(width), i32(default_bin), i32(nb),
+            i32(missing), i32(threshold), jnp.asarray(default_left),
+            jnp.asarray(is_cat), jnp.asarray(cat_member))
 
         lc, rc = int(left_sum[2]), int(right_sum[2])
         cmin, cmax = info.cmin, info.cmax
@@ -277,10 +311,8 @@ class SerialTreeLearner:
                         else (right_info, left_info))
         need = self._splittable(small) or self._splittable(large)
         if need:
-            sb, sm, sstart = self._window(small.begin, small.count)
-            swin = _slice_window(self.buffer, sb, sm)
-            small.hist = build_histogram(self.binned, grad, hess, swin,
-                                         small.count, sstart)
+            small.hist = self._leaf_histogram(grad, hess, small.begin,
+                                              small.count)
             large.hist = subtract_histogram(info.hist, small.hist)
         info.hist = None
         self._schedule_find_best(left_info, feature_mask)

@@ -13,6 +13,15 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+import jax  # noqa: E402
+
+# persistent compilation cache: the padded-bucket shapes recur across tests,
+# so reruns skip nearly all XLA compiles
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.expanduser("~/.cache/lgbm_tpu_xla"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
