@@ -343,7 +343,11 @@ class Booster:
 
     def _inner_eval_pred(self, score):
         s = np.asarray(score, np.float64)
-        if self._gbdt.objective is not None:
+        if self._gbdt.average_output:
+            # RF: summed scores average to the output directly (rf.hpp
+            # EvalOneMetric passes a null objective — no conversion)
+            s = s / max(self._gbdt.num_iterations(), 1)
+        elif self._gbdt.objective is not None:
             s = self._gbdt.objective.convert_output(s)
         return s[0] if s.shape[0] == 1 else s.T.reshape(-1)
 

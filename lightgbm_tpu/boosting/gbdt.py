@@ -346,10 +346,13 @@ class GBDT:
         if pred_contrib:
             return self._predict_contrib(data, num_iteration)
         raw = self.predict_raw(data, num_iteration, start_iteration)
-        if not raw_score and self.objective is not None:
-            raw = self.objective.convert_output(raw)
-        elif not raw_score and self.loaded_objective_str:
-            raw = _convert_by_name(self.loaded_objective_str, raw)
+        # averaged-output models (RF) already emit converted values
+        # (gbdt.cpp:600: convert only when !average_output_)
+        if not raw_score and not self.average_output:
+            if self.objective is not None:
+                raw = self.objective.convert_output(raw)
+            elif self.loaded_objective_str:
+                raw = _convert_by_name(self.loaded_objective_str, raw)
         if self.num_model == 1:
             return raw[0]
         return raw.T   # (N, K)

@@ -67,13 +67,16 @@ class RF(GBDT):
         iters = max(self.num_iterations(), 1)
         return score / iters
 
+    # The averaged score already IS the output (e.g. a probability for
+    # binary labels), so metrics must NOT re-convert through the objective
+    # (reference rf.hpp EvalOneMetric passes nullptr).
     def eval_train(self):
         out = []
         if not self.train_metrics:
             return out
         score = self._averaged(np.asarray(self.train_score, np.float64))
         for m in self.train_metrics:
-            for name, value in m.eval(score, self.objective):
+            for name, value in m.eval(score, None):
                 out.append(("training", name, value, m.bigger_is_better))
         return out
 
@@ -82,6 +85,6 @@ class RF(GBDT):
         for v in self.valid_sets:
             score = self._averaged(np.asarray(v.score, np.float64))
             for m in v.metrics:
-                for name, value in m.eval(score, self.objective):
+                for name, value in m.eval(score, None):
                     out.append((v.name, name, value, m.bigger_is_better))
         return out

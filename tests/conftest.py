@@ -2,18 +2,28 @@
 
 The reference has no mockable network backend (SURVEY.md §4); here every
 distributed mode is exercised deterministically in-process by forcing the CPU
-platform with 8 virtual devices.  Must run before the first jax import.
+platform with 8 virtual devices.
+
+NOTE: a sitecustomize may import jax before this file runs (and the ambient
+env may pin JAX_PLATFORMS to a remote TPU tunnel with ~170ms roundtrips —
+unusable for a test loop), so env vars alone are NOT enough; the platform
+must be overridden through jax.config, which works until the first backend
+initialisation.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"   # tests always run on the CPU mesh
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")   # effective even post-import
+assert jax.default_backend() == "cpu", "tests must run on the CPU mesh"
+assert len(jax.devices()) == 8, "expected 8 virtual CPU devices"
 
 # persistent compilation cache: the padded-bucket shapes recur across tests,
 # so reruns skip nearly all XLA compiles

@@ -137,15 +137,26 @@ def test_goss_and_dart(regression_data):
         assert res["valid_0:l2"] < 1.0, (boosting, res)
 
 
-def test_rf(binary_data):
-    x, y, xt, yt = binary_data
+def test_rf():
+    # mirrors reference test_engine.py:50-73 (breast_cancer, rf,
+    # binary_logloss < 0.25, predict == eval score)
+    from sklearn.datasets import load_breast_cancer
+    from sklearn.model_selection import train_test_split
+    x, y = load_breast_cancer(return_X_y=True)
+    x, xt, y, yt = train_test_split(x, y, test_size=0.1, random_state=42)
     bst = _train({"objective": "binary", "boosting": "rf",
-                  "metric": "binary_error", "num_leaves": 63,
-                  "bagging_freq": 1, "bagging_fraction": 0.7,
-                  "feature_fraction": 0.7}, x, y, 30, valid=(xt, yt))
+                  "metric": "binary_logloss", "num_leaves": 50,
+                  "bagging_freq": 1, "bagging_fraction": 0.5,
+                  "feature_fraction": 0.5, "min_data_in_bin": 1},
+                 x, y, 50, valid=(xt, yt))
     res = dict((f"{d}:{n}", v) for d, n, v, _ in bst.eval_valid())
-    # reference test_engine.py:50-73 asserts error < 0.25
-    assert res["valid_0:binary_error"] < 0.25
+    assert res["valid_0:binary_logloss"] < 0.25
+    # predict must match the eval-time averaged probabilities
+    pred = bst.predict(xt)
+    eps = 1e-15
+    ll = -np.mean(yt * np.log(np.clip(pred, eps, 1))
+                  + (1 - yt) * np.log(np.clip(1 - pred, eps, 1)))
+    assert abs(ll - res["valid_0:binary_logloss"]) < 1e-5
 
 
 def test_bagging_weights(regression_data):
