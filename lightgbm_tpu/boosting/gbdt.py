@@ -151,16 +151,14 @@ class GBDT:
     # ------------------------------------------------------------------
     def bagging(self, it: int):
         """Row bagging via a device bernoulli mask partition
-        (gbdt.cpp:161-243 semantics, binomial count)."""
+        (gbdt.cpp:161-243 semantics, binomial count).  The selection layout
+        is the learner's (serial: one permutation buffer; data-parallel:
+        per-shard buffers), so it delegates to ``learner.bagging_state``."""
         if not self.need_bagging or it % self.bag_freq != 0:
             return
-        from ..ops.bagging import bagging_partition
         seed = (self.config.bagging_seed + it) & 0x7FFFFFFF
-        key = jax.random.PRNGKey(seed)
-        buf, cnt = bagging_partition(key, self.learner.n_pad, self.num_data,
-                                     self.bag_fraction)
-        self.bag_buffer = buf
-        self.bag_count = int(cnt)
+        self.bag_buffer, self.bag_count = self.learner.bagging_state(
+            seed, self.bag_fraction)
 
     def _tree_multiplier(self) -> float:
         return 1.0
@@ -261,7 +259,7 @@ class GBDT:
             dt = device_tree(tree, self.train_set, self.config.num_leaves)
             self.train_score = self.train_score.at[class_id].set(
                 add_tree_score(self.train_score[class_id],
-                               self.learner.binned, dt, 1.0))
+                               self.learner.traverse_binned, dt, 1.0))
         else:
             self.train_score = self.train_score.at[class_id].set(
                 self.learner.update_score(self.train_score[class_id], tree))
@@ -305,7 +303,7 @@ class GBDT:
             if tree.num_leaves > 1:
                 dt = device_tree(tree, self.train_set, self.config.num_leaves)
                 self.train_score = self.train_score.at[k].set(
-                    add_tree_score(self.train_score[k], self.learner.binned,
+                    add_tree_score(self.train_score[k], self.learner.traverse_binned,
                                    dt, -1.0))
                 for v in self.valid_sets:
                     v.score = v.score.at[k].set(

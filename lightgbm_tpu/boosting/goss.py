@@ -20,6 +20,13 @@ class GOSS(GBDT):
         cfg = self.config
         if cfg.top_rate + cfg.other_rate > 1.0:
             raise LightGBMError("top_rate + other_rate <= 1.0 in GOSS")
+        from ..parallel.data_parallel import DataParallelTreeLearner
+        if isinstance(self.learner, DataParallelTreeLearner):
+            # GOSS selection is a global top-k over one permutation buffer;
+            # the row-sharded learners need a per-shard variant (planned)
+            raise LightGBMError(
+                "boosting=goss with tree_learner=data/voting is not "
+                "supported yet; use tree_learner=feature or serial")
         self.need_bagging = False      # GOSS replaces bagging
         self._goss_multiplier = None
         self.is_constant_hessian = False

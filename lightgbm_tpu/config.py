@@ -112,6 +112,7 @@ class Config:
         self.extra: Dict[str, Any] = {}   # unknown (pass-through) params
         merged = dict(params or {})
         merged.update(kwargs)
+        self.raw_params = dict(merged)    # as passed, pre-normalization
         self.set(merged)
 
     # -- main entry -------------------------------------------------------

@@ -27,6 +27,12 @@ import jax.numpy as jnp
 _CHUNK = 8192
 
 
+def num_chunks_for(m: int) -> int:
+    """Scan chunk count for a window of static size m: chunked only when
+    evenly divisible (power-of-two buckets always are above _CHUNK)."""
+    return m // _CHUNK if (m > _CHUNK and m % _CHUNK == 0) else 1
+
+
 def _chunk_histogram(bins_u8: jnp.ndarray, gh: jnp.ndarray) -> jnp.ndarray:
     """(C, G) uint8 bins x (C, 3) [g, h, 1] -> (G, 256, 3) partial sums.
 

@@ -16,6 +16,13 @@ and the only host<->device synchronisation is fetching the two children's
 small best-split records.  Leaf windows are padded to power-of-two buckets so
 the number of compiled programs stays ~log2(N).
 
+The device interactions are isolated behind hook methods (``_init_state``,
+``_leaf_histogram``, ``_leaf_totals``, ``_find_best``, ``_partition``,
+``_subtract``, ``bagging_state``) that the distributed learners override:
+data-parallel reshards rows over the mesh and psum-reduces histograms,
+feature-parallel shards the scan and allreduce-maxes the split record,
+voting-parallel adds the top-k election (``lightgbm_tpu/parallel/``).
+
 Monotone-constraint midpoint propagation mirrors
 serial_tree_learner.cpp:765-776; forced splits (JSON BFS) mirror
 ``ForceSplits`` (serial_tree_learner.cpp:546-701).
@@ -25,14 +32,14 @@ from __future__ import annotations
 
 import functools
 import math
-from typing import Dict, List, Optional
+from typing import Dict, List, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..ops.histogram import (_gather_rows, _histogram_scan, bucket_size,
-                             _CHUNK, subtract_histogram)
+                             num_chunks_for, subtract_histogram)
 from ..ops.partition import _partition_kernel, apply_leaf_outputs
 from ..ops.split import (F_DEFAULT_LEFT, F_FEATURE, F_GAIN, F_IS_CAT,
                          F_LEFT_C, F_LEFT_G, F_LEFT_H, F_LEFT_OUT,
@@ -42,9 +49,18 @@ from ..utils.log import log_debug, log_warning
 from .tree import Tree, construct_bitset
 
 
-@functools.partial(jax.jit, static_argnames=("m",))
-def _slice_window(buffer, begin, m):
-    return jax.lax.dynamic_slice(buffer, (begin,), (m,))
+class SplitParams(NamedTuple):
+    """Host-side decoded split of one leaf, fed to the partition kernel."""
+    group: int
+    offset: int
+    width: int
+    default_bin: int
+    num_bin: int
+    missing: int
+    threshold: int
+    default_left: bool
+    is_cat: bool
+    cat_member: np.ndarray    # (256,) bool
 
 
 @functools.partial(jax.jit, static_argnames=("m", "num_chunks"))
@@ -77,17 +93,19 @@ def _hist_totals(hist):
 
 
 class _LeafInfo:
-    __slots__ = ("begin", "count", "total", "cmin", "cmax", "hist", "best",
-                 "depth", "output")
+    __slots__ = ("leaf_id", "begin", "count", "total", "cmin", "cmax",
+                 "hist", "best", "depth", "output")
 
-    def __init__(self, begin, count, total, cmin, cmax, hist, depth, output):
+    def __init__(self, leaf_id, begin, count, total, cmin, cmax, hist, depth,
+                 output):
+        self.leaf_id = leaf_id
         self.begin = begin
-        self.count = count
+        self.count = count          # global row count
         self.total = total          # (g, h, c) floats on host
         self.cmin = cmin
         self.cmax = cmax
-        self.hist = hist            # device (G, 256, 3) or None
-        self.best = None            # device dict from find_best
+        self.hist = hist            # learner-specific device handle or None
+        self.best = None            # device (packed, cat mask) from find_best
         self.depth = depth
         self.output = output        # current leaf output value
 
@@ -108,6 +126,12 @@ class SerialTreeLearner:
              else config.seed + 2) & 0x7FFFFFFF)
         self.forced_splits = None   # parsed forced-split JSON (dict) or None
 
+    @property
+    def traverse_binned(self):
+        """(N, G) device matrix for full-traversal score paths; the sharded
+        learners override this with a replicated copy."""
+        return self.binned
+
     # ------------------------------------------------------------------
     def _feature_mask(self) -> jnp.ndarray:
         nf = self.dataset.num_features
@@ -126,27 +150,71 @@ class SerialTreeLearner:
         b = min(begin, self.n_pad - m)
         return b, m, begin - b
 
-    def _leaf_histogram(self, grad, hess, begin: int, count: int):
-        b, m, start = self._window(begin, count)
-        num_chunks = m // _CHUNK if (m > _CHUNK and m % _CHUNK == 0) else 1
-        return _window_histogram(self.binned, grad, hess, self.buffer,
-                                 jnp.asarray(b, jnp.int32),
-                                 jnp.asarray(start, jnp.int32),
-                                 jnp.asarray(count, jnp.int32), m, num_chunks)
-
     # ------------------------------------------------------------------
-    def train(self, grad, hess, indices_buffer=None, data_count=None,
-              feature_mask=None) -> Tree:
-        """Grow one tree.  ``indices_buffer`` is a device (n_pad,) int32
-        permutation whose first ``data_count`` entries are the usable rows
-        (bagging); defaults to all rows."""
-        cfg = self.config
+    # overridable device hooks
+    # ------------------------------------------------------------------
+    def bagging_state(self, seed: int, fraction: float):
+        """Device bagging selection; returns (opaque state for ``train``'s
+        ``indices_buffer``, global selected count)."""
+        from ..ops.bagging import bagging_partition
+        key = jax.random.PRNGKey(seed)
+        buf, cnt = bagging_partition(key, self.n_pad, self.num_data,
+                                     fraction)
+        return buf, int(cnt)
+
+    def _init_state(self, indices_buffer, data_count, grad, hess):
+        """Set up the per-tree partition state; returns possibly-resharded
+        (grad, hess) used by all later hook calls."""
         if indices_buffer is None:
             indices_buffer = self._full_indices
             data_count = self.num_data
         # private copy: the partition kernel donates (in-place updates) the
         # buffer, and the caller's bagging buffer must survive across trees
         self.buffer = jnp.array(indices_buffer, copy=True)
+        self.data_count = data_count
+        return grad, hess
+
+    def _leaf_histogram(self, grad, hess, info: _LeafInfo):
+        b, m, start = self._window(info.begin, info.count)
+        num_chunks = num_chunks_for(m)
+        return _window_histogram(self.binned, grad, hess, self.buffer,
+                                 jnp.asarray(b, jnp.int32),
+                                 jnp.asarray(start, jnp.int32),
+                                 jnp.asarray(info.count, jnp.int32), m,
+                                 num_chunks)
+
+    def _leaf_totals(self, hist) -> np.ndarray:
+        return np.asarray(_hist_totals(hist), np.float64)
+
+    def _subtract(self, parent_hist, small_hist):
+        return subtract_histogram(parent_hist, small_hist)
+
+    def _find_best(self, info: _LeafInfo, feature_mask):
+        flat = info.hist.reshape(-1, 3)
+        return self.ctx.find_best(flat, info.total, (info.cmin, info.cmax),
+                                  feature_mask)
+
+    def _partition(self, info: _LeafInfo, sp: SplitParams, left_count: int,
+                   right_count: int, right_leaf: int):
+        """Partition the leaf's rows; left child keeps ``info.leaf_id``."""
+        b, m, start = self._window(info.begin, info.count)
+        i32 = lambda v: jnp.asarray(v, jnp.int32)
+        self.buffer = _window_partition(
+            self.binned, self.buffer, i32(b), m, i32(start), i32(info.count),
+            i32(sp.group), i32(sp.offset), i32(sp.width), i32(sp.default_bin),
+            i32(sp.num_bin), i32(sp.missing), i32(sp.threshold),
+            jnp.asarray(sp.default_left), jnp.asarray(sp.is_cat),
+            jnp.asarray(sp.cat_member))
+
+    # ------------------------------------------------------------------
+    def train(self, grad, hess, indices_buffer=None, data_count=None,
+              feature_mask=None) -> Tree:
+        """Grow one tree.  ``indices_buffer`` is the opaque bagging state
+        from ``bagging_state`` (serial: a device (n_pad,) int32 permutation
+        whose first ``data_count`` entries are the usable rows); defaults to
+        all rows."""
+        cfg = self.config
+        grad, hess = self._init_state(indices_buffer, data_count, grad, hess)
         if feature_mask is None:
             feature_mask = self._feature_mask()
 
@@ -156,7 +224,8 @@ class SerialTreeLearner:
         if self.dataset.num_groups == 0 or self.dataset.num_features == 0:
             # no usable features: single-leaf tree from the root sums
             g, h = map(float, (jnp.sum(grad), jnp.sum(hess)))
-            root = _LeafInfo(0, data_count, np.asarray([g, h, data_count]),
+            root = _LeafInfo(0, 0, self.data_count,
+                             np.asarray([g, h, self.data_count]),
                              -math.inf, math.inf, None, 0,
                              self._leaf_output(g, h))
             tree.leaf_value[0] = root.output
@@ -165,10 +234,11 @@ class SerialTreeLearner:
             return tree
 
         # root
-        hist = self._leaf_histogram(grad, hess, 0, data_count)
-        total = np.asarray(_hist_totals(hist), np.float64)
-        root = _LeafInfo(0, data_count, total, -math.inf, math.inf, hist, 0,
-                         self._leaf_output(total[0], total[1]))
+        root = _LeafInfo(0, 0, self.data_count, None, -math.inf, math.inf,
+                         None, 0, 0.0)
+        root.hist = self._leaf_histogram(grad, hess, root)
+        root.total = self._leaf_totals(root.hist)
+        root.output = self._leaf_output(root.total[0], root.total[1])
         tree.leaf_value[0] = root.output
         leaves[0] = root
         self._schedule_find_best(root, feature_mask)
@@ -211,9 +281,7 @@ class SerialTreeLearner:
         if not self._splittable(info):
             info.best = None
             return
-        flat = info.hist.reshape(-1, 3)
-        info.best = self.ctx.find_best(
-            flat, info.total, (info.cmin, info.cmax), feature_mask)
+        info.best = self._find_best(info, feature_mask)
 
     def _pick_best_leaf(self, leaves, forced_queue):
         best_leaf, best_rec, best_gain = None, None, 0.0
@@ -235,23 +303,26 @@ class SerialTreeLearner:
     def _apply_split(self, tree, leaves, leaf, best, grad, hess, feature_mask,
                      forced=False):
         ds = self.dataset
-        cfg = self.config
         info = leaves[leaf]
         vec, mask_dev = best
         f = int(vec[F_FEATURE])
         real_f = ds.used_features[f]
         mapper = ds.bin_mappers[real_f]
-        group = int(ds.f_group[f])
-        offset = int(ds.f_offset[f])
         nb = int(ds.f_num_bin[f])
         default_bin = int(ds.f_default_bin[f])
-        width = nb - (1 if default_bin == 0 else 0)
-        missing = int(ds.f_missing_type[f])
         is_cat = bool(vec[F_IS_CAT])
-        threshold = int(vec[F_THRESHOLD])
-        default_left = bool(vec[F_DEFAULT_LEFT])
-        cat_member = (np.asarray(mask_dev, bool) if is_cat
-                      else np.zeros(256, bool))
+        sp = SplitParams(
+            group=int(ds.f_group[f]),
+            offset=int(ds.f_offset[f]),
+            width=nb - (1 if default_bin == 0 else 0),
+            default_bin=default_bin,
+            num_bin=nb,
+            missing=int(ds.f_missing_type[f]),
+            threshold=int(vec[F_THRESHOLD]),
+            default_left=bool(vec[F_DEFAULT_LEFT]),
+            is_cat=is_cat,
+            cat_member=(np.asarray(mask_dev, bool) if is_cat
+                        else np.zeros(256, bool)))
 
         left_sum = np.asarray([vec[F_LEFT_G], vec[F_LEFT_H], vec[F_LEFT_C]],
                               np.float64)
@@ -262,7 +333,7 @@ class SerialTreeLearner:
         gain = float(vec[F_GAIN])
 
         if is_cat:
-            member_bins = [int(bb) for bb in np.nonzero(cat_member)[0]
+            member_bins = [int(bb) for bb in np.nonzero(sp.cat_member)[0]
                            if bb < nb]
             bitset_inner = construct_bitset(member_bins)
             cats = [int(mapper.bin_2_categorical[bb]) for bb in member_bins
@@ -271,24 +342,18 @@ class SerialTreeLearner:
             bitset = construct_bitset(cats)
             right_leaf = tree.split_categorical(
                 leaf, f, real_f, bitset_inner, bitset, left_out, right_out,
-                int(left_sum[2]), int(right_sum[2]), gain, missing)
+                int(left_sum[2]), int(right_sum[2]), gain, sp.missing)
         else:
-            threshold_double = mapper.bin_to_value(threshold)
+            threshold_double = mapper.bin_to_value(sp.threshold)
             right_leaf = tree.split(
-                leaf, f, real_f, threshold, threshold_double, left_out,
+                leaf, f, real_f, sp.threshold, threshold_double, left_out,
                 right_out, int(left_sum[2]), int(right_sum[2]), gain,
-                missing, default_left)
-
-        # device partition (no sync needed: left count comes from SplitInfo)
-        b, m, start = self._window(info.begin, info.count)
-        i32 = lambda v: jnp.asarray(v, jnp.int32)
-        self.buffer = _window_partition(
-            self.binned, self.buffer, i32(b), m, i32(start), i32(info.count),
-            i32(group), i32(offset), i32(width), i32(default_bin), i32(nb),
-            i32(missing), i32(threshold), jnp.asarray(default_left),
-            jnp.asarray(is_cat), jnp.asarray(cat_member))
+                sp.missing, sp.default_left)
 
         lc, rc = int(left_sum[2]), int(right_sum[2])
+        # device partition (no sync needed: counts come from the SplitInfo)
+        self._partition(info, sp, lc, rc, right_leaf)
+
         cmin, cmax = info.cmin, info.cmax
         lmin, lmax, rmin, rmax = cmin, cmax, cmin, cmax
         mono = int(ds.monotone_constraints[f])
@@ -299,10 +364,10 @@ class SerialTreeLearner:
             else:
                 lmin, rmax = mid, mid
 
-        left_info = _LeafInfo(info.begin, lc, left_sum, lmin, lmax, None,
-                              info.depth + 1, left_out)
-        right_info = _LeafInfo(info.begin + lc, rc, right_sum, rmin, rmax,
-                               None, info.depth + 1, right_out)
+        left_info = _LeafInfo(leaf, info.begin, lc, left_sum, lmin, lmax,
+                              None, info.depth + 1, left_out)
+        right_info = _LeafInfo(right_leaf, info.begin + lc, rc, right_sum,
+                               rmin, rmax, None, info.depth + 1, right_out)
         leaves[leaf] = left_info
         leaves[right_leaf] = right_info
 
@@ -311,9 +376,8 @@ class SerialTreeLearner:
                         else (right_info, left_info))
         need = self._splittable(small) or self._splittable(large)
         if need:
-            small.hist = self._leaf_histogram(grad, hess, small.begin,
-                                              small.count)
-            large.hist = subtract_histogram(info.hist, small.hist)
+            small.hist = self._leaf_histogram(grad, hess, small)
+            large.hist = self._subtract(info.hist, small.hist)
         info.hist = None
         self._schedule_find_best(left_info, feature_mask)
         self._schedule_find_best(right_info, feature_mask)
