@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""HIGGS-shaped training benchmark vs the reference baselines.
+
+The reference's headline number (BASELINE.md, ``docs/Experiments.rst:106``)
+is 238.5 s for 500 boosting iterations on HIGGS (10.5M rows x 28 dense
+features, num_leaves=255ish config); the OpenCL GPU learner's implied
+wall-clock is ~80 s (``docs/GPU-Performance.rst:164-175``).  This script
+reproduces that workload shape with synthetic data (HIGGS itself is not on
+disk: standard-normal features with a planted nonlinear signal, so trees
+have real structure to find) and times the training loop on whatever
+backend JAX resolves (the driver runs it on one real TPU chip).
+
+Prints exactly ONE line of JSON to stdout:
+  {"metric": ..., "value": <train seconds>, "unit": "s",
+   "vs_baseline": <value / 238.5>, ...extra diagnostic keys}
+
+Modes:
+  python bench.py                  # full: 10.5M x 28, 500 iters
+  python bench.py --quick          # 1M x 28, 50 iters
+  python bench.py --rows N --iters K --profile   # custom + phase sync
+Environment overrides: BENCH_ROWS, BENCH_ITERS, BENCH_PROFILE=1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# persistent XLA compile cache: the padded-bucket programs recur across runs
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/lgbm_tpu_xla"))
+
+import numpy as np
+
+BASELINE_CPU_S = 238.5   # docs/Experiments.rst:106 (500 iters, 2x E5-2670v3)
+BASELINE_GPU_S = 80.0    # implied ~3x GPU speedup, docs/GPU-Performance.rst
+
+
+def synth_higgs(rows: int, cols: int = 28, seed: int = 7):
+    """Standard-normal features with a planted nonlinear binary signal.
+
+    The signal weights come from a FIXED rng so train and held-out sets
+    (different ``seed``) share one ground-truth concept.
+    """
+    wrng = np.random.default_rng(20260730)
+    w1 = wrng.standard_normal(cols).astype(np.float32) / np.sqrt(cols)
+    w2 = wrng.standard_normal(cols).astype(np.float32) / np.sqrt(cols)
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((rows, cols), dtype=np.float32)
+    logits = (x @ w1) + np.abs(x @ w2) - 0.79  # ~balanced classes
+    p = 1.0 / (1.0 + np.exp(-2.0 * logits))
+    y = (rng.random(rows, dtype=np.float32) < p).astype(np.float32)
+    return x, y
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int,
+                    default=int(os.environ.get("BENCH_ROWS", 10_500_000)))
+    ap.add_argument("--iters", type=int,
+                    default=int(os.environ.get("BENCH_ITERS", 500)))
+    ap.add_argument("--num-leaves", type=int, default=255)
+    ap.add_argument("--max-bin", type=int, default=255)
+    ap.add_argument("--learning-rate", type=float, default=0.1)
+    ap.add_argument("--quick", action="store_true",
+                    help="1M rows, 50 iterations")
+    ap.add_argument("--profile", action="store_true",
+                    default=bool(int(os.environ.get("BENCH_PROFILE", "0"))),
+                    help="block per phase for honest phase attribution "
+                         "(slows the run; don't use for the headline number)")
+    ap.add_argument("--eval-rows", type=int, default=500_000,
+                    help="held-out rows for AUC (0 disables)")
+    ap.add_argument("--engine", choices=["auto", "host"], default="auto",
+                    help="'host' forces the host-driven learner")
+    args = ap.parse_args()
+    if args.quick:
+        args.rows = min(args.rows, 1_000_000)
+        args.iters = min(args.iters, 50)
+
+    import jax
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data.dataset import BinnedDataset
+    from lightgbm_tpu.utils.log import TRAIN_TIMER, set_verbosity
+
+    set_verbosity(0)
+    backend = jax.default_backend()
+    dev = str(jax.devices()[0])
+
+    t0 = time.perf_counter()
+    x, y = synth_higgs(args.rows)
+    xt = yt = None
+    if args.eval_rows > 0:
+        xt, yt = synth_higgs(args.eval_rows, seed=1234)
+    t_gen = time.perf_counter() - t0
+
+    cfg = Config({
+        "objective": "binary", "metric": "auc",
+        "num_leaves": args.num_leaves, "max_bin": args.max_bin,
+        "learning_rate": args.learning_rate,
+        "min_data_in_leaf": 20, "min_sum_hessian_in_leaf": 1e-3,
+        "bagging_fraction": 1.0, "feature_fraction": 1.0,
+        "verbosity": 0,
+    })
+
+    t0 = time.perf_counter()
+    ds = BinnedDataset.construct_from_matrix(x, cfg)
+    ds.metadata.set_label(y)
+    t_bin = time.perf_counter() - t0
+
+    bst = create_boosting(cfg)
+    TRAIN_TIMER.reset()
+    TRAIN_TIMER.sync = args.profile
+
+    # warm-up: run 2 iterations to trigger + cache the XLA compiles, then
+    # restart training so the timed region measures steady-state execution
+    t0 = time.perf_counter()
+    bst.init_train(ds)
+    for _ in range(2):
+        bst.train_one_iter()
+    jax.block_until_ready(bst.train_score)
+    t_warm = time.perf_counter() - t0
+
+    # timed region
+    bst = create_boosting(cfg)
+    bst.init_train(ds)
+    TRAIN_TIMER.reset()
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        if bst.train_one_iter():
+            break
+    jax.block_until_ready(bst.train_score)
+    train_s = time.perf_counter() - t0
+
+    auc = None
+    if xt is not None:
+        from lightgbm_tpu.ops.traverse import add_tree_score, device_tree
+        import jax.numpy as jnp
+        vds = BinnedDataset.construct_from_matrix(xt, cfg, reference=ds)
+        binned_d = jnp.asarray(vds.binned)
+        score = jnp.zeros(args.eval_rows, jnp.float32)
+        for tree in bst.models:
+            if tree.num_leaves > 1:
+                score = add_tree_score(
+                    score, binned_d, device_tree(tree, ds, cfg.num_leaves),
+                    1.0)
+        raw = np.asarray(score, np.float64)
+        order = np.argsort(-raw, kind="stable")
+        lbl = yt[order]
+        tps = np.cumsum(lbl)
+        fps = np.cumsum(1.0 - lbl)
+        auc = float(np.trapezoid(tps, fps) / (tps[-1] * fps[-1])) \
+            if tps[-1] > 0 and fps[-1] > 0 else float("nan")
+
+    iters_run = bst.num_iterations()
+    phases = {k: round(v, 3) for k, v in sorted(TRAIN_TIMER.acc.items())}
+    result = {
+        "metric": f"higgs_synth_{args.rows}x28_{args.iters}iter_wallclock",
+        "value": round(train_s, 3),
+        "unit": "s",
+        "vs_baseline": round(train_s / BASELINE_CPU_S, 4),
+        "baseline_cpu_s": BASELINE_CPU_S,
+        "baseline_gpu_s": BASELINE_GPU_S,
+        "speedup_vs_cpu": round(BASELINE_CPU_S / train_s, 2),
+        "rows": args.rows,
+        "iters": iters_run,
+        "time_per_tree_ms": round(1000.0 * train_s / max(iters_run, 1), 2),
+        "rows_per_sec": round(args.rows * iters_run / train_s, 0),
+        "auc": round(auc, 6) if auc is not None else None,
+        "backend": backend,
+        "device": dev,
+        "phases_s": phases,
+        "profile_sync": args.profile,
+        "gen_s": round(t_gen, 2),
+        "bin_s": round(t_bin, 2),
+        "warmup_compile_s": round(t_warm, 2),
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

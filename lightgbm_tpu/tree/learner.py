@@ -45,7 +45,7 @@ from ..ops.split import (F_DEFAULT_LEFT, F_FEATURE, F_GAIN, F_IS_CAT,
                          F_LEFT_C, F_LEFT_G, F_LEFT_H, F_LEFT_OUT,
                          F_RIGHT_C, F_RIGHT_G, F_RIGHT_H, F_RIGHT_OUT,
                          F_THRESHOLD, SplitContext)
-from ..utils.log import log_debug, log_warning
+from ..utils.log import TRAIN_TIMER, log_debug, log_warning
 from .tree import Tree, construct_bitset
 
 
@@ -177,34 +177,43 @@ class SerialTreeLearner:
     def _leaf_histogram(self, grad, hess, info: _LeafInfo):
         b, m, start = self._window(info.begin, info.count)
         num_chunks = num_chunks_for(m)
-        return _window_histogram(self.binned, grad, hess, self.buffer,
-                                 jnp.asarray(b, jnp.int32),
-                                 jnp.asarray(start, jnp.int32),
-                                 jnp.asarray(info.count, jnp.int32), m,
-                                 num_chunks)
+        TRAIN_TIMER.start("hist")
+        out = _window_histogram(self.binned, grad, hess, self.buffer,
+                                jnp.asarray(b, jnp.int32),
+                                jnp.asarray(start, jnp.int32),
+                                jnp.asarray(info.count, jnp.int32), m,
+                                num_chunks)
+        return TRAIN_TIMER.stop_sync("hist", out)
 
     def _leaf_totals(self, hist) -> np.ndarray:
-        return np.asarray(_hist_totals(hist), np.float64)
+        TRAIN_TIMER.start("totals_fetch")
+        out = np.asarray(_hist_totals(hist), np.float64)
+        TRAIN_TIMER.stop("totals_fetch")
+        return out
 
     def _subtract(self, parent_hist, small_hist):
         return subtract_histogram(parent_hist, small_hist)
 
     def _find_best(self, info: _LeafInfo, feature_mask):
         flat = info.hist.reshape(-1, 3)
-        return self.ctx.find_best(flat, info.total, (info.cmin, info.cmax),
-                                  feature_mask)
+        TRAIN_TIMER.start("find_split")
+        out = self.ctx.find_best(flat, info.total, (info.cmin, info.cmax),
+                                 feature_mask)
+        return TRAIN_TIMER.stop_sync("find_split", out)
 
     def _partition(self, info: _LeafInfo, sp: SplitParams, left_count: int,
                    right_count: int, right_leaf: int):
         """Partition the leaf's rows; left child keeps ``info.leaf_id``."""
         b, m, start = self._window(info.begin, info.count)
         i32 = lambda v: jnp.asarray(v, jnp.int32)
+        TRAIN_TIMER.start("partition")
         self.buffer = _window_partition(
             self.binned, self.buffer, i32(b), m, i32(start), i32(info.count),
             i32(sp.group), i32(sp.offset), i32(sp.width), i32(sp.default_bin),
             i32(sp.num_bin), i32(sp.missing), i32(sp.threshold),
             jnp.asarray(sp.default_left), jnp.asarray(sp.is_cat),
             jnp.asarray(sp.cat_member))
+        TRAIN_TIMER.stop_sync("partition", self.buffer)
 
     # ------------------------------------------------------------------
     def train(self, grad, hess, indices_buffer=None, data_count=None,
@@ -284,17 +293,26 @@ class SerialTreeLearner:
         info.best = self._find_best(info, feature_mask)
 
     def _pick_best_leaf(self, leaves, forced_queue):
+        TRAIN_TIMER.start("fetch")
+        # batch the pending device fetches (usually the two new children)
+        # into one transfer instead of one round trip each
+        pending = [leaf for leaf in leaves
+                   if leaves[leaf].best is not None
+                   and not isinstance(leaves[leaf].best[0], np.ndarray)]
+        if pending:
+            fetched = jax.device_get([leaves[leaf].best[0]
+                                      for leaf in pending])
+            for leaf, vec in zip(pending, fetched):
+                leaves[leaf].best = (np.asarray(vec), leaves[leaf].best[1])
         best_leaf, best_rec, best_gain = None, None, 0.0
         for leaf in sorted(leaves):
             info = leaves[leaf]
             if info.best is None:
                 continue
-            if not isinstance(info.best[0], np.ndarray):
-                info.best = (np.asarray(info.best[0]),
-                             info.best[1])   # mask fetched lazily if needed
             gain = float(info.best[0][F_GAIN])
             if gain > best_gain:
                 best_leaf, best_rec, best_gain = leaf, info.best, gain
+        TRAIN_TIMER.stop("fetch")
         if best_leaf is None:
             return None, None
         return best_leaf, best_rec

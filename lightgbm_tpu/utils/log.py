@@ -69,11 +69,19 @@ class Timer:
     First-class version of the reference's compile-time TIMETAG counters
     (``serial_tree_learner.cpp:14-41``): ``timer.start("hist")`` /
     ``timer.stop("hist")`` accumulate, ``timer.report()`` pretty-prints.
+
+    With ``sync=True`` the :meth:`stop_sync` variant blocks on the device
+    value before stopping the clock, so phase times attribute device work to
+    the phase that dispatched it (JAX dispatch is async; without syncing,
+    device time piles up at the next host fetch).  Leave ``sync=False`` in
+    production — blocking per phase serialises the device pipeline.
     """
 
     def __init__(self):
         self.acc = {}
+        self.counts = {}
         self._t0 = {}
+        self.sync = False
 
     def start(self, tag: str) -> None:
         self._t0[tag] = time.perf_counter()
@@ -82,10 +90,25 @@ class Timer:
         t0 = self._t0.pop(tag, None)
         if t0 is not None:
             self.acc[tag] = self.acc.get(tag, 0.0) + time.perf_counter() - t0
+            self.counts[tag] = self.counts.get(tag, 0) + 1
+
+    def stop_sync(self, tag: str, value=None):
+        """Stop after blocking on ``value`` when ``sync`` profiling is on."""
+        if self.sync and value is not None:
+            import jax
+            jax.block_until_ready(value)
+        self.stop(tag)
+        return value
 
     def report(self) -> str:
         return ", ".join(f"{k}={v:.3f}s" for k, v in sorted(self.acc.items()))
 
     def reset(self) -> None:
         self.acc.clear()
+        self.counts.clear()
         self._t0.clear()
+
+
+#: process-global training-phase timer (wired through the tree learner and
+#: the boosting loop; ``bench.py`` reads and resets it)
+TRAIN_TIMER = Timer()
