@@ -63,7 +63,11 @@ def main() -> int:
     ap.add_argument("--iters", type=int,
                     default=int(os.environ.get("BENCH_ITERS", 500)))
     ap.add_argument("--num-leaves", type=int, default=255)
-    ap.add_argument("--max-bin", type=int, default=255)
+    ap.add_argument("--max-bin", type=int,
+                    default=int(os.environ.get("BENCH_MAX_BIN", 63)),
+                    help="63 matches the reference GPU learner's own "
+                         "benchmark setting (docs/GPU-Performance.rst); "
+                         "255 matches the CPU run")
     ap.add_argument("--learning-rate", type=float, default=0.1)
     ap.add_argument("--quick", action="store_true",
                     help="1M rows, 50 iterations")
@@ -73,8 +77,11 @@ def main() -> int:
                          "(slows the run; don't use for the headline number)")
     ap.add_argument("--eval-rows", type=int, default=500_000,
                     help="held-out rows for AUC (0 disables)")
-    ap.add_argument("--engine", choices=["auto", "host"], default="auto",
-                    help="'host' forces the host-driven learner")
+    ap.add_argument("--engine", choices=["auto", "device", "host"],
+                    default="device",
+                    help="device = on-device wave grower (one dispatch per "
+                         "iteration); host = host-driven learner; auto = "
+                         "device on TPU")
     args = ap.parse_args()
     if args.quick:
         args.rows = min(args.rows, 1_000_000)
@@ -104,6 +111,8 @@ def main() -> int:
         "min_data_in_leaf": 20, "min_sum_hessian_in_leaf": 1e-3,
         "bagging_fraction": 1.0, "feature_fraction": 1.0,
         "verbosity": 0,
+        "device_growth": {"device": "on", "host": "off",
+                          "auto": "auto"}[args.engine],
     })
 
     t0 = time.perf_counter()
@@ -115,30 +124,36 @@ def main() -> int:
     TRAIN_TIMER.reset()
     TRAIN_TIMER.sync = args.profile
 
-    # warm-up: run 2 iterations to trigger + cache the XLA compiles, then
-    # restart training so the timed region measures steady-state execution
+    # warm-up: 2 iterations trigger + cache the XLA compiles.  The SAME
+    # booster is then timed for the remaining iterations (a fresh booster
+    # would re-trace its jitted grower and put the compile back into the
+    # timed region); per-iteration cost does not depend on the iteration
+    # index, so wall-clock extrapolates linearly.
     t0 = time.perf_counter()
     bst.init_train(ds)
-    for _ in range(2):
+    warm = min(2, args.iters)
+    for _ in range(warm):
         bst.train_one_iter()
     jax.block_until_ready(bst.train_score)
     t_warm = time.perf_counter() - t0
 
-    # timed region
-    bst = create_boosting(cfg)
-    bst.init_train(ds)
+    # timed region: the remaining iterations
     TRAIN_TIMER.reset()
     t0 = time.perf_counter()
-    for _ in range(args.iters):
+    for _ in range(args.iters - warm):
         if bst.train_one_iter():
             break
     jax.block_until_ready(bst.train_score)
-    train_s = time.perf_counter() - t0
+    timed_s = time.perf_counter() - t0
+    iters_timed = bst.num_iterations() - warm
+    per_iter = timed_s / max(iters_timed, 1)
+    train_s = per_iter * bst.num_iterations()   # full-run equivalent
 
     auc = None
     if xt is not None:
         from lightgbm_tpu.ops.traverse import add_tree_score, device_tree
         import jax.numpy as jnp
+        bst._flush_pending()
         vds = BinnedDataset.construct_from_matrix(xt, cfg, reference=ds)
         binned_d = jnp.asarray(vds.binned)
         score = jnp.zeros(args.eval_rows, jnp.float32)
@@ -167,7 +182,9 @@ def main() -> int:
         "speedup_vs_cpu": round(BASELINE_CPU_S / train_s, 2),
         "rows": args.rows,
         "iters": iters_run,
-        "time_per_tree_ms": round(1000.0 * train_s / max(iters_run, 1), 2),
+        "timed_iters": iters_timed,
+        "timed_s": round(timed_s, 3),
+        "time_per_tree_ms": round(1000.0 * per_iter, 2),
         "rows_per_sec": round(args.rows * iters_run / train_s, 0),
         "auc": round(auc, 6) if auc is not None else None,
         "backend": backend,

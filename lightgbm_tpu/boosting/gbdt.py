@@ -20,6 +20,8 @@ from ..config import Config
 from ..data.dataset import BinnedDataset
 from ..metrics import create_metrics, create_metric
 from ..objectives import create_objective
+from ..ops.grow import (DeviceGrower, REC_F_FIELDS, REC_I_FIELDS,
+                        device_growth_eligible)
 from ..ops.histogram import bucket_size
 from ..ops.traverse import DeviceTree, add_tree_score, device_tree
 from ..tree.learner import SerialTreeLearner
@@ -32,7 +34,8 @@ MODEL_VERSION = "v2"
 
 
 class _ValidSet:
-    __slots__ = ("dataset", "binned_d", "score", "metrics", "name")
+    __slots__ = ("dataset", "binned_d", "score", "metrics", "name",
+                 "applied_models")
 
     def __init__(self, dataset, binned_d, score, metrics, name):
         self.dataset = dataset
@@ -40,6 +43,50 @@ class _ValidSet:
         self.score = score
         self.metrics = metrics
         self.name = name
+        self.applied_models = 0     # models already added to `score`
+
+
+class _PendingTree:
+    """Device-side split records of a tree grown by the DeviceGrower;
+    replayed into a host ``Tree`` lazily (``GBDT._flush_pending``)."""
+
+    __slots__ = ("rec_i", "rec_f", "nl", "root_value", "shrinkage", "bias")
+
+    def __init__(self, rec_i, rec_f, nl, root_value, shrinkage, bias):
+        self.rec_i = rec_i
+        self.rec_f = rec_f
+        self.nl = nl
+        self.root_value = root_value
+        self.shrinkage = shrinkage
+        self.bias = bias
+        for arr in (rec_i, rec_f, nl, root_value):
+            try:
+                arr.copy_to_host_async()
+            except AttributeError:
+                pass
+
+    def materialize(self, dataset, config) -> Tree:
+        nl = int(np.asarray(self.nl))
+        tree = Tree(config.num_leaves)
+        if nl <= 1:
+            tree.leaf_value[0] = float(np.asarray(self.root_value))
+        else:
+            rec_i = np.asarray(self.rec_i)
+            rec_f = np.asarray(self.rec_f)
+            for s in range(nl - 1):
+                leaf, right, f, thr, dl = (int(v) for v in rec_i[s])
+                (gain, lg, lh, lc, rg, rh, rc, lout, rout) = (
+                    float(v) for v in rec_f[s])
+                real_f = dataset.used_features[f]
+                mapper = dataset.bin_mappers[real_f]
+                missing = int(dataset.f_missing_type[f])
+                tree.split(leaf, f, real_f, thr,
+                           mapper.bin_to_value(thr), lout, rout, int(lc),
+                           int(rc), gain, missing, bool(dl))
+            tree.apply_shrinkage(self.shrinkage)
+        if abs(self.bias) > K_EPSILON:
+            tree.add_bias(self.bias)
+        return tree
 
 
 class GBDT:
@@ -112,6 +159,28 @@ class GBDT:
         self.is_constant_hessian = bool(
             self.objective and self.objective.is_constant_hessian
             and not self.need_bagging)
+        # on-device wave grower (one dispatch per iteration, no per-split
+        # host sync) when the configuration is eligible
+        self._grower = None
+        self._device_stop = False
+        self._iters_since_check = 0
+        mode = str(getattr(cfg, "device_growth", "off")).lower()
+        want = mode == "on" or (mode == "auto"
+                                and jax.default_backend() == "tpu")
+        if want and type(self) is GBDT:
+            serial = (cfg.tree_learner == "serial"
+                      or int(cfg.num_machines) <= 1)
+            if serial and device_growth_eligible(cfg, train_set,
+                                                 self.objective,
+                                                 self.num_model):
+                self._grower = DeviceGrower(train_set, cfg)
+                log_info("Using on-device tree growth (device_growth="
+                         f"{mode})")
+            elif mode == "on":
+                log_warning("device_growth=on requested but the "
+                            "configuration is not eligible (categorical/"
+                            "monotone/bagging/multiclass/renew objective); "
+                            "falling back to the host-driven learner")
 
     def add_valid(self, valid_set: BinnedDataset, name: str):
         if not valid_set.check_align(self.train_set):
@@ -125,8 +194,12 @@ class GBDT:
         if valid_set.metadata.init_score is not None:
             init = np.asarray(valid_set.metadata.init_score, np.float64)
             score = jnp.asarray(init.reshape(self.num_model, -1), jnp.float32)
-        self.valid_sets.append(_ValidSet(
-            valid_set, jnp.asarray(valid_set.binned), score, metrics, name))
+        vs = _ValidSet(valid_set, jnp.asarray(valid_set.binned), score,
+                       metrics, name)
+        # device path: models that predate this valid set are skipped in
+        # catch-up, matching the host path (which only applies new trees)
+        vs.applied_models = len(self.models)
+        self.valid_sets.append(vs)
 
     # ------------------------------------------------------------------
     def boost_from_average(self, class_id: int) -> float:
@@ -138,8 +211,11 @@ class GBDT:
             if abs(init_score) > K_EPSILON:
                 self.train_score = self.train_score.at[class_id].add(
                     init_score)
-                for v in self.valid_sets:
-                    v.score = v.score.at[class_id].add(init_score)
+                if self._grower is None:
+                    # device path: valid sets receive the bias through the
+                    # materialized first tree at catch-up time instead
+                    for v in self.valid_sets:
+                        v.score = v.score.at[class_id].add(init_score)
                 log_info(f"Start training from score {init_score:f}")
                 return init_score
         elif self.objective.name in ("regression_l1", "quantile", "mape"):
@@ -167,6 +243,9 @@ class GBDT:
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
         """One boosting iteration; returns True when training should stop
         (no splittable leaves), mirroring GBDT::TrainOneIter."""
+        if (self._grower is not None and gradients is None
+                and hessians is None):
+            return self._train_one_iter_device()
         init_scores = [0.0] * self.num_model
         if gradients is None or hessians is None:
             for k in range(self.num_model):
@@ -223,6 +302,71 @@ class GBDT:
             return True
         self.iter += 1
         return False
+
+    # ------------------------------------------------------------------
+    # on-device fast path: one dispatch per iteration, no per-split sync
+    def _train_one_iter_device(self) -> bool:
+        if self._device_stop:
+            return True
+        init_score = self.boost_from_average(0)
+        grad, hess = self.objective.get_gradients(self.train_score)
+        if grad.ndim > 1:
+            grad, hess = grad[0], hess[0]
+        mask = self.learner._feature_mask()
+        score, rec_i, rec_f, nl, root_val = self._grower.grow_one_iter(
+            self.train_score[0], grad, hess, mask,
+            self.shrinkage_rate * self._tree_multiplier())
+        self.train_score = score[None, :]
+        self.models.append(_PendingTree(
+            rec_i, rec_f, nl, root_val,
+            self.shrinkage_rate * self._tree_multiplier(), init_score))
+        self.iter += 1
+        # stump check: one tiny fetch every 32 iterations detects the
+        # "no more splittable leaves" stop condition without a per-iter
+        # round trip (the reference checks every iteration, gbdt.cpp:412)
+        self._iters_since_check += 1
+        if self._iters_since_check >= 32:
+            self._iters_since_check = 0
+            if int(np.asarray(nl)) <= 1:
+                self._trim_device_stumps()
+                return True
+        return False
+
+    def _trim_device_stumps(self):
+        """Remove trailing stump iterations (the device path keeps
+        dispatching until the periodic check notices training stalled)."""
+        self._flush_pending()
+        while self.models and self.models[-1].num_leaves <= 1:
+            del self.models[-1]
+            self.iter -= 1
+        self._device_stop = True
+        log_warning("Stopped training because there are no more leaves "
+                    "that meet the split requirements")
+
+    def _flush_pending(self):
+        """Materialize all device-grown trees into host ``Tree`` objects."""
+        for i, m in enumerate(self.models):
+            if isinstance(m, _PendingTree):
+                self.models[i] = m.materialize(self.train_set, self.config)
+
+    def _catch_up_valid_scores(self):
+        """Apply not-yet-applied models to every valid set's score (the
+        device path defers valid updates to evaluation time)."""
+        if not self.valid_sets:
+            return
+        self._flush_pending()
+        total = len(self.models)
+        for v in self.valid_sets:
+            while v.applied_models < total:
+                idx = v.applied_models
+                tree = self.models[idx]
+                if tree.num_leaves > 1:
+                    dt = device_tree(tree, self.train_set,
+                                     self.config.num_leaves)
+                    v.score = v.score.at[idx % self.num_model].set(
+                        add_tree_score(v.score[idx % self.num_model],
+                                       v.binned_d, dt, 1.0))
+                v.applied_models = idx + 1
 
     def _adjust_gradients(self, grad, hess):
         return grad, hess
@@ -283,6 +427,8 @@ class GBDT:
 
     def eval_valid(self) -> List[Tuple[str, str, float, bool]]:
         out = []
+        if self._grower is not None:
+            self._catch_up_valid_scores()
         for v in self.valid_sets:
             score = np.asarray(v.score, np.float64)
             for m in v.metrics:
@@ -298,6 +444,7 @@ class GBDT:
         """Remove the last iteration's trees and scores (gbdt.cpp:414-430)."""
         if not self.models:
             return
+        self._flush_pending()
         for k in range(self.num_model):
             tree = self.models[-self.num_model + k]
             if tree.num_leaves > 1:
@@ -315,6 +462,7 @@ class GBDT:
     # prediction (raw host data)
     def predict_raw(self, data: np.ndarray, num_iteration: int = -1,
                     start_iteration: int = 0) -> np.ndarray:
+        self._flush_pending()
         data = np.ascontiguousarray(np.asarray(data, np.float64))
         n = data.shape[0]
         out = np.zeros((self.num_model, n), np.float64)
@@ -331,6 +479,7 @@ class GBDT:
 
     def predict(self, data, num_iteration: int = -1, raw_score=False,
                 pred_leaf=False, pred_contrib=False, start_iteration=0):
+        self._flush_pending()
         if pred_leaf:
             data = np.ascontiguousarray(np.asarray(data, np.float64))
             total_iter = self.num_iterations()
@@ -375,6 +524,7 @@ class GBDT:
     # ------------------------------------------------------------------
     def feature_importance(self, importance_type="split",
                            iteration: int = -1) -> np.ndarray:
+        self._flush_pending()
         nf = self.max_feature_idx + 1
         out = np.zeros(nf, np.float64)
         total_iter = self.num_iterations()
@@ -391,6 +541,7 @@ class GBDT:
     # ------------------------------------------------------------------
     # model serialization (gbdt_model_text.cpp:243-330 format "v2")
     def model_to_string(self, start_iteration=0, num_iteration=-1) -> str:
+        self._flush_pending()
         lines = ["tree", f"version={MODEL_VERSION}",
                  f"num_class={max(int(self.config.num_class), 1)}",
                  f"num_tree_per_iteration={self.num_model}",

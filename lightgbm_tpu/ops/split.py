@@ -104,13 +104,17 @@ class FeatureMeta(NamedTuple):
 
     @classmethod
     def from_dataset(cls, dataset, feature_subset=None,
-                     slot_base: int = 0) -> "FeatureMeta":
+                     slot_base: int = 0,
+                     slot_stride: int = 256) -> "FeatureMeta":
         """Build metadata arrays; ``feature_subset`` (host int array) keeps
         only those used-feature indices (feature-parallel shards).  Entries
         of -1 in the subset are padding (masked via num_bin=1).
         ``slot_base`` shifts slot indices into a device-local histogram
         (feature-parallel: the shard owning groups [base/256, ...) sees only
-        its own slots)."""
+        its own slots).  ``slot_stride`` is the per-group slot pitch of the
+        flat histogram (256 for the host path; the device grower packs
+        groups at the smallest power-of-two that fits, e.g. 64 for
+        max_bin=63, to keep the one-hot matmul narrow)."""
         nb = dataset.f_num_bin.astype(np.int32)
         db = dataset.f_default_bin.astype(np.int32)
         off = dataset.f_offset.astype(np.int64)
@@ -133,8 +137,8 @@ class FeatureMeta(NamedTuple):
 
         b = np.arange(256, dtype=np.int64)[None, :]
         shift = (db == 0).astype(np.int64)
-        slot = grp[:, None] * 256 + off[:, None] + b - shift[:, None] \
-            - int(slot_base)
+        slot = grp[:, None] * int(slot_stride) + off[:, None] + b \
+            - shift[:, None] - int(slot_base)
         valid = (b < nb[:, None]) & (b != db[:, None])
         slot = np.where(valid, slot, 0)
         return cls(jnp.asarray(slot, jnp.int32), jnp.asarray(valid),

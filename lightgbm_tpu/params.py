@@ -368,6 +368,14 @@ PARAM_SCHEMA: Sequence[Param] = (
        desc="alias-level switch for float64 accumulation on TPU", section="device"),
     _p("tpu_rows_per_block", int, 0, (),
        desc="rows per Pallas histogram grid block; 0 = auto", section="device"),
+    _p("device_growth", str, "auto", ("tpu_device_growth",),
+       check="auto/on/off",
+       desc="fully on-device wave-synchronized tree growth (one dispatch "
+            "per boosting iteration, no per-split host sync). auto = on "
+            "for TPU backends when the config is eligible (serial learner, "
+            "single model, numerical features, no bagging/monotone/forced "
+            "splits); off = always use the host-driven learner",
+       section="device"),
     _p("deterministic", bool, True, (),
        desc="bit-deterministic device reductions where possible", section="device"),
 )

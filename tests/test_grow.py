@@ -1,0 +1,153 @@
+"""On-device wave grower (ops/grow.py) vs the host-driven learner.
+
+The device grower must reproduce the host learner's trees exactly when no
+budget pressure or numeric near-ties are involved, and match its metrics
+otherwise.  Runs on the CPU backend (conftest forces the 8-device CPU
+mesh); the same code path runs on real TPU."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.boosting import create_boosting
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.data.dataset import BinnedDataset
+from lightgbm_tpu.ops.grow import device_growth_eligible
+
+
+def _make(params, x, y, device):
+    cfg = Config({**params,
+                  "device_growth": "on" if device else "off"})
+    ds = BinnedDataset.construct_from_matrix(x, cfg)
+    ds.metadata.set_label(y)
+    bst = create_boosting(cfg)
+    bst.init_train(ds)
+    return bst
+
+
+def _split_set(tree):
+    return sorted((int(tree.split_feature_inner[i]),
+                   int(tree.threshold_in_bin[i]),
+                   int(tree.internal_count[i]))
+                  for i in range(tree.num_leaves - 1))
+
+
+@pytest.fixture(scope="module")
+def reg_data():
+    rng = np.random.default_rng(5)
+    n = 4000
+    x = rng.standard_normal((n, 6)).astype(np.float32)
+    y = (x[:, 0] + 2 * (x[:, 1] > 0.3) - 1.5 * (x[:, 2] < -0.5)
+         + 0.1 * rng.standard_normal(n)).astype(np.float32)
+    return x, y
+
+
+def test_device_tree_matches_host(reg_data):
+    """With a generous leaf budget both paths should produce the same
+    split set (wave batching only reorders node numbering)."""
+    x, y = reg_data
+    params = {"objective": "regression", "num_leaves": 64,
+              "learning_rate": 0.1, "min_data_in_leaf": 50}
+    bh = _make(params, x, y, False)
+    bd = _make(params, x, y, True)
+    assert bd._grower is not None and bh._grower is None
+    bh.train_one_iter()
+    bd.train_one_iter()
+    bd._flush_pending()
+    th, td = bh.models[0], bd.models[0]
+    assert th.num_leaves == td.num_leaves
+    assert _split_set(th) == _split_set(td)
+    assert np.allclose(bh.predict(x), bd.predict(x), atol=1e-5)
+
+
+def test_device_binary_auc(reg_data):
+    rng = np.random.default_rng(7)
+    n = 20000
+    x = rng.standard_normal((n, 10)).astype(np.float32)
+    w = rng.standard_normal(10)
+    p = 1 / (1 + np.exp(-(x @ w + np.abs(x[:, 0]))))
+    y = (p > rng.random(n)).astype(np.float32)
+    params = {"objective": "binary", "metric": "auc", "num_leaves": 31,
+              "learning_rate": 0.1, "min_data_in_leaf": 20}
+    from sklearn.metrics import roc_auc_score
+    aucs = []
+    for device in (False, True):
+        bst = _make(params, x, y, device)
+        for _ in range(20):
+            if bst.train_one_iter():
+                break
+        aucs.append(roc_auc_score(y, bst.predict(x, raw_score=True)))
+    assert aucs[1] > aucs[0] - 0.01, aucs
+
+
+def test_device_model_roundtrip(reg_data):
+    x, y = reg_data
+    params = {"objective": "regression", "num_leaves": 31,
+              "learning_rate": 0.2}
+    bst = _make(params, x, y, True)
+    for _ in range(5):
+        bst.train_one_iter()
+    text = bst.model_to_string()
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    loaded = GBDT.load_model_from_string(text)
+    assert np.allclose(loaded.predict(x, raw_score=True),
+                       bst.predict(x, raw_score=True), atol=1e-6)
+
+
+def test_device_stop_on_unsplittable():
+    """Constant labels -> zero gain everywhere -> training must stop and
+    trailing stump iterations be trimmed (host parity)."""
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((500, 4)).astype(np.float32)
+    y = np.zeros(500, np.float32)
+    params = {"objective": "regression", "num_leaves": 15,
+              "learning_rate": 0.1}
+    bst = _make(params, x, y, True)
+    stopped = False
+    for _ in range(40):
+        if bst.train_one_iter():
+            stopped = True
+            break
+    assert stopped
+    bst._flush_pending()
+    assert all(t.num_leaves <= 1 for t in bst.models) or not bst.models
+
+
+def test_device_valid_eval_catches_up(reg_data):
+    """The device path defers valid-score updates to evaluation time; the
+    caught-up score must equal predicting the valid rows directly."""
+    x, y = reg_data
+    xt, yt = x[:1000], y[:1000]
+    params = {"objective": "regression", "metric": "l2", "num_leaves": 31,
+              "learning_rate": 0.1}
+    bd = _make(params, x, y, True)
+    cfg = bd.config
+    vds = BinnedDataset.construct_from_matrix(xt, cfg,
+                                              reference=bd.train_set)
+    from lightgbm_tpu.data.dataset import Metadata
+    vds.metadata = Metadata(len(yt))
+    vds.metadata.set_label(yt)
+    bd.add_valid(vds, "v")
+    for _ in range(8):
+        bd.train_one_iter()
+    res = dict((f"{d}:{n}", v) for d, n, v, _ in bd.eval_valid())
+    direct = float(np.mean((bd.predict(xt) - yt) ** 2))
+    assert res["v:l2"] == pytest.approx(direct, rel=1e-5)
+
+
+def test_eligibility_gates():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((300, 4)).astype(np.float32)
+    y = rng.standard_normal(300).astype(np.float32)
+    # bagging disables the device path
+    cfg = Config({"objective": "regression", "bagging_fraction": 0.5,
+                  "bagging_freq": 1})
+    ds = BinnedDataset.construct_from_matrix(x, cfg)
+    from lightgbm_tpu.objectives import create_objective
+    obj = create_objective(cfg)
+    obj.init(ds.metadata or __import__(
+        "lightgbm_tpu.data.dataset", fromlist=["Metadata"]).Metadata(300),
+        300)
+    assert not device_growth_eligible(cfg, ds, obj, 1)
+    cfg2 = Config({"objective": "regression"})
+    assert device_growth_eligible(cfg2, ds, obj, 1)
+    assert not device_growth_eligible(cfg2, ds, obj, 3)
