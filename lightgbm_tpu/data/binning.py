@@ -42,10 +42,12 @@ def _feq(a: float, b: float) -> bool:
     return a <= b <= upper
 
 
-def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
-                     max_bin: int, total_cnt: int,
-                     min_data_in_bin: int) -> List[float]:
-    """Greedy equal-count binning (reference GreedyFindBin, bin.cpp:74-150)."""
+def _greedy_find_bin_scalar(distinct_values: np.ndarray, counts: np.ndarray,
+                            max_bin: int, total_cnt: int,
+                            min_data_in_bin: int) -> List[float]:
+    """Reference-shaped scalar implementation of GreedyFindBin
+    (bin.cpp:74-150); kept as the semantics oracle for the vectorized
+    version below (tests fuzz one against the other)."""
     num_distinct = len(distinct_values)
     bounds: List[float] = []
     if max_bin <= 0:
@@ -89,6 +91,81 @@ def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
             if not is_big[i]:
                 rest_bin_cnt -= 1
                 mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
+    for i in range(len(upper)):
+        val = _double_upper_bound((upper[i] + lower[i + 1]) / 2.0)
+        if not bounds or not _feq(bounds[-1], val):
+            bounds.append(val)
+    bounds.append(math.inf)
+    return bounds
+
+
+def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
+                     max_bin: int, total_cnt: int,
+                     min_data_in_bin: int) -> List[float]:
+    """Greedy equal-count binning (reference GreedyFindBin, bin.cpp:74-150).
+
+    Vectorized: instead of walking every distinct value, each emitted
+    boundary is located with O(log n) searches (cumulative-count
+    searchsorted + next-big-bin lookup), so the cost is O(max_bin log n)
+    rather than O(n) Python iterations.  Bit-identical to the scalar
+    oracle above (fuzz-tested)."""
+    num_distinct = len(distinct_values)
+    if max_bin <= 0:
+        raise ValueError("max_bin must be positive")
+    if num_distinct == 0:
+        return [math.inf]
+    bounds: List[float] = []
+    if num_distinct <= max_bin:
+        # small case: emit a boundary whenever >= min_data_in_bin rows
+        # accumulated; the scalar loop is already O(max_bin)
+        return _greedy_find_bin_scalar(distinct_values, counts, max_bin,
+                                       total_cnt, min_data_in_bin)
+
+    if min_data_in_bin > 0:
+        max_bin = max(1, min(max_bin, total_cnt // min_data_in_bin))
+    counts = np.asarray(counts, np.int64)
+    mean0 = total_cnt / max_bin
+    is_big = counts >= mean0
+    rest_bin_cnt = max_bin - int(is_big.sum())
+    rest_sample_cnt = total_cnt - int(counts[is_big].sum())
+    mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
+
+    cum = np.cumsum(counts)                       # inclusive prefix counts
+    cum_nb = np.cumsum(np.where(is_big, 0, counts))  # non-big prefix
+    big_idx = np.nonzero(is_big)[0]
+
+    upper: List[float] = []
+    lower: List[float] = [float(distinct_values[0])]
+    i0 = 0                                        # first index of open bin
+    limit = num_distinct - 1                      # scalar loop scans [0, n-2]
+    while len(upper) < max_bin - 1:
+        base = cum[i0 - 1] if i0 > 0 else 0
+        # condition A: is_big[i]
+        j = np.searchsorted(big_idx, i0)
+        i_a = int(big_idx[j]) if j < len(big_idx) else limit
+        # condition B: cur = cum[i] - base >= mean_bin_size (clamped to the
+        # open segment: mean can hit 0 at the tail, where the scalar loop
+        # still fires no earlier than the running index)
+        i_b = max(int(np.searchsorted(cum, base + mean_bin_size)), i0)
+        # condition C: is_big[i+1] and cur >= max(1, mean/2)
+        i_half = int(np.searchsorted(cum, base + max(1.0,
+                                                     mean_bin_size * 0.5)))
+        jj = np.searchsorted(big_idx, max(i0, i_half) + 1)
+        i_c = int(big_idx[jj]) - 1 if jj < len(big_idx) else limit
+        i = min(i_a, i_b, i_c)
+        if i >= limit:        # no boundary fires within the scanned range
+            break
+        upper.append(float(distinct_values[i]))
+        lower.append(float(distinct_values[i + 1]))
+        if len(upper) >= max_bin - 1:
+            break
+        # rest_sample_cnt drops by all non-big counts consumed so far
+        if not is_big[i]:
+            nb_consumed = int(cum_nb[i])
+            rest_bin_cnt -= 1
+            mean_bin_size = (rest_sample_cnt - nb_consumed) \
+                / max(rest_bin_cnt, 1)
+        i0 = i + 1
     for i in range(len(upper)):
         val = _double_upper_bound((upper[i] + lower[i + 1]) / 2.0)
         if not bounds or not _feq(bounds[-1], val):
@@ -202,38 +279,46 @@ class BinMapper:
         if zero_cnt < 0:
             zero_cnt = 0
 
-        # distinct values with counts; merge near-equal doubles, fold the
-        # implicit zeros in at their sorted position
+        # distinct values with counts; merge near-equal doubles (pairwise
+        # CheckDoubleEqualOrdered on consecutive sorted samples, as the
+        # reference does), fold the implicit zeros in at their sorted
+        # position.  Vectorized: group boundaries are where the next value
+        # exceeds nextafter(prev); the group's representative is its LAST
+        # member (the scalar loop kept overwriting with ``cur``).
         values.sort(kind="stable")
-        distinct: List[float] = []
-        counts: List[int] = []
-        if num_sample_values == 0 or (values[0] > 0.0 and zero_cnt > 0):
-            distinct.append(0.0)
-            counts.append(zero_cnt)
         if num_sample_values > 0:
-            distinct.append(float(values[0]))
-            counts.append(1)
-        for i in range(1, num_sample_values):
-            prev, cur = float(values[i - 1]), float(values[i])
-            if not _feq(prev, cur):
-                if prev < 0.0 and cur > 0.0:
-                    distinct.append(0.0)
-                    counts.append(zero_cnt)
-                distinct.append(cur)
-                counts.append(1)
-            else:
-                distinct[-1] = cur  # keep the larger representative
-                counts[-1] += 1
-        if num_sample_values > 0 and values[-1] < 0.0 and zero_cnt > 0:
-            distinct.append(0.0)
-            counts.append(zero_cnt)
+            same = values[1:] <= np.nextafter(values[:-1], np.inf)
+            starts = np.concatenate([[0], np.nonzero(~same)[0] + 1])
+            ends = np.concatenate([starts[1:], [num_sample_values]])
+            dv = values[ends - 1]
+            cv = (ends - starts).astype(np.int64)
+            # zero-group insertion exactly where the scalar loop put it:
+            # between a group ending < 0 and the next starting > 0 (note:
+            # the scalar test uses the RAW neighbours values[i-1], values[i]
+            # of the group boundary, which are the group's last/next-first)
+            prevs = values[starts[1:] - 1]
+            curs = values[starts[1:]]
+            zpos = np.nonzero((prevs < 0.0) & (curs > 0.0))[0]
+            if len(zpos):
+                at = int(zpos[0]) + 1
+                dv = np.insert(dv, at, 0.0)
+                cv = np.insert(cv, at, zero_cnt)
+            elif values[0] > 0.0 and zero_cnt > 0:
+                dv = np.concatenate([[0.0], dv])
+                cv = np.concatenate([[zero_cnt], cv])
+            elif values[-1] < 0.0 and zero_cnt > 0:
+                dv = np.concatenate([dv, [0.0]])
+                cv = np.concatenate([cv, [zero_cnt]])
+        else:
+            dv = np.asarray([0.0])
+            cv = np.asarray([zero_cnt], dtype=np.int64)
 
-        if not distinct:
-            distinct, counts = [0.0], [max(total_sample_cnt - na_cnt, 0)]
-        self.min_val = float(distinct[0])
-        self.max_val = float(distinct[-1])
-        dv = np.asarray(distinct, dtype=np.float64)
-        cv = np.asarray(counts, dtype=np.int64)
+        if len(dv) == 0:
+            dv = np.asarray([0.0])
+            cv = np.asarray([max(total_sample_cnt - na_cnt, 0)],
+                            dtype=np.int64)
+        self.min_val = float(dv[0])
+        self.max_val = float(dv[-1])
 
         cnt_in_bin: List[int] = []
         if bin_type == BIN_NUMERICAL:
@@ -248,12 +333,10 @@ class BinMapper:
                     self.missing_type = MISSING_NONE
             self.bin_upper_bound = np.asarray(bounds, dtype=np.float64)
             self.num_bin = len(bounds)
-            cnt_in_bin = [0] * self.num_bin
-            i_bin = 0
-            for v, c in zip(dv, cv):
-                while v > self.bin_upper_bound[i_bin]:
-                    i_bin += 1
-                cnt_in_bin[i_bin] += int(c)
+            i_bins = np.searchsorted(self.bin_upper_bound, dv, side="left")
+            cnt_in_bin = np.bincount(i_bins, weights=cv.astype(np.float64),
+                                     minlength=self.num_bin
+                                     ).astype(np.int64).tolist()
             if self.missing_type == MISSING_NAN:
                 cnt_in_bin[self.num_bin - 1] = na_cnt
         else:
