@@ -135,13 +135,18 @@ class GBDT:
         md = train_set.metadata
         self.has_init_score = md.init_score is not None
         if self.has_init_score:
-            init = np.asarray(md.init_score, np.float64)
-            if len(init) == n:
-                init = init[None, :].repeat(self.num_model, 0) \
-                    if self.num_model == 1 else init.reshape(1, n)
-            else:
-                init = init.reshape(self.num_model, n)
-            self.train_score = jnp.asarray(init, jnp.float32)
+            # class-major layout [k*num_data + i], like the reference's
+            # Metadata (metadata.cpp checks the exact size and Fatal()s on
+            # mismatch; a silently clamped (1, N) here trained wrong
+            # multiclass models)
+            init = np.asarray(md.init_score, np.float64).reshape(-1)
+            if len(init) != n * self.num_model:
+                raise LightGBMError(
+                    f"Initial score size doesn't match data size: got "
+                    f"{len(init)}, expected num_data * num_model = "
+                    f"{n} * {self.num_model}")
+            self.train_score = jnp.asarray(
+                init.reshape(self.num_model, n), jnp.float32)
         self.train_metrics = create_metrics(cfg)
         for m in self.train_metrics:
             m.init(md, n)
@@ -192,8 +197,16 @@ class GBDT:
             m.init(valid_set.metadata, valid_set.num_data)
         score = jnp.zeros((self.num_model, valid_set.num_data), jnp.float32)
         if valid_set.metadata.init_score is not None:
-            init = np.asarray(valid_set.metadata.init_score, np.float64)
-            score = jnp.asarray(init.reshape(self.num_model, -1), jnp.float32)
+            init = np.asarray(valid_set.metadata.init_score,
+                              np.float64).reshape(-1)
+            if len(init) != valid_set.num_data * self.num_model:
+                raise LightGBMError(
+                    f"Initial score size doesn't match data size: got "
+                    f"{len(init)}, expected "
+                    f"{valid_set.num_data} * {self.num_model}")
+            score = jnp.asarray(
+                init.reshape(self.num_model, valid_set.num_data),
+                jnp.float32)
         vs = _ValidSet(valid_set, jnp.asarray(valid_set.binned), score,
                        metrics, name)
         # device path: models that predate this valid set are skipped in

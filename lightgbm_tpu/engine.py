@@ -139,8 +139,10 @@ def _continue_from(init_model, params, train_set):
             "free_raw_data=False")
     init_score = prev._gbdt.predict_raw(raw_source)
     md = train_set._handle.metadata
-    md.set_init_score(init_score.reshape(-1) if init_score.shape[0] == 1
-                      else init_score.T.reshape(-1))
+    # predict_raw returns (num_model, N); Metadata stores class-major
+    # [k*N + i] like the reference (basic.py _set_init_score_by_predictor
+    # regroups to exactly this layout)
+    md.set_init_score(init_score.reshape(-1))
     booster = Booster(params=params, train_set=train_set)
     booster._gbdt.models = list(prev._gbdt.models)
     booster._gbdt.num_init_iteration = prev._gbdt.num_iterations()

@@ -63,6 +63,8 @@ REC_I_FIELDS = 5    # leaf, right, feature, threshold, default_left
 REC_F_FIELDS = 9    # gain, lg, lh, lc, rg, rh, rc, left_out, right_out
 
 
+
+
 def _ceil_to(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
@@ -111,20 +113,32 @@ class DeviceGrower:
         self.p_num_bin = i32(nbins)
         self.p_missing = i32(dataset.f_missing_type)
 
-        self.wave_width = min(40, max(self.num_leaves - 1, 1))
+        # wave width: 5 stat columns per leaf (g hi/lo, h hi/lo, count);
+        # 25 leaves x 5 = 125 columns fills exactly one 128-lane MXU tile
+        # (200 columns at W=40 measured ~2x slower per wave)
+        # (W=40 and W=51 measured 974/981 ms per tree vs 720 ms at W=25 on
+        # the 10.5M-row benchmark: the extra column tiles cost more than
+        # the saved waves)
+        self.wave_width = min(25, max(self.num_leaves - 1, 1))
         self.lr = float(config.learning_rate)
         self._grow = jax.jit(self._grow_impl)
 
     # ------------------------------------------------------------------
     # wave histogram: one dense pass for up to W pending leaves
     # ------------------------------------------------------------------
-    def _wave_hist(self, leaf_id, gh5, pending):
+    def _wave_hist(self, binned, leaf_id, gh5, pending):
         """(n_pad,) leaf ids, (n_pad, 5) bf16 [g_hi,g_lo,h_hi,h_lo,1],
-        (W,) pending leaf ids (-1 = empty slot) -> (W, S, 3) f32."""
+        (W,) pending leaf ids (-1 = empty slot) -> (W, S, 3) f32.
+
+        The one-hot must stay a bare iota-compare so XLA fuses its
+        generation into the dot operand (a multi-hot built as
+        ``one_hot(..).sum()`` materializes in HBM measured 3.5x slower;
+        fusing the leaf-id split application into this scan also measured
+        2x slower - the extra data dependency breaks matmul pipelining)."""
         g, nb, w = self.num_groups, self.nb, self.wave_width
         ch = _CHUNK
         n_chunks = self.n_pad // ch
-        binned_c = self.binned.reshape(n_chunks, ch, g)
+        binned_c = binned.reshape(n_chunks, ch, g)
         leaf_c = leaf_id.reshape(n_chunks, ch)
         gh5_c = gh5.reshape(n_chunks, ch, 5)
 
@@ -162,11 +176,15 @@ class DeviceGrower:
         return ok
 
     # ------------------------------------------------------------------
-    def _grow_impl(self, score, grad, hess, feature_mask, lr):
+    def _grow_impl(self, binned, binned_t, score, grad, hess, feature_mask,
+                   lr):
         """One boosting iteration on device.  Returns (new_score, rec_i
         (L-1,5) i32, rec_f (L-1,9) f32, num_leaves i32, root_value f32).
         ``lr`` is traced so callbacks may reset the learning rate without
-        recompiling."""
+        recompiling.  The binned matrices are arguments, not closures: a
+        closed-over array becomes an XLA constant and ships inside the
+        compile request (fatal at 10M-row scale on a remote-compile
+        backend)."""
         L, W, S = self.num_leaves, self.wave_width, self.num_slots
         n = self.n_pad
         npad_rows = n - self.num_data
@@ -186,33 +204,34 @@ class DeviceGrower:
 
         class _S(NamedTuple):
             leaf_id: jnp.ndarray        # (n,) i32
-            hist: jnp.ndarray           # (L, S, 3) f32
-            total: jnp.ndarray          # (L, 3) f32
-            value: jnp.ndarray          # (L,) f32
-            depth: jnp.ndarray          # (L,) i32
-            best: jnp.ndarray           # (L, 13) f32, gain NEG_INF if none
+            hist: jnp.ndarray           # (L+1, S, 3) f32
+            total: jnp.ndarray          # (L+1, 3) f32
+            value: jnp.ndarray          # (L+1,) f32
+            depth: jnp.ndarray          # (L+1,) i32
+            best: jnp.ndarray           # (L+1, 13) f32, gain NEG_INF if none
             nl: jnp.ndarray             # i32 leaves so far
             done: jnp.ndarray           # bool
-            rec_i: jnp.ndarray          # (L-1, 5) i32
-            rec_f: jnp.ndarray          # (L-1, 9) f32
+            rec_i: jnp.ndarray          # (L, 5) i32   (last row = junk)
+            rec_f: jnp.ndarray          # (L, 9) f32   (last row = junk)
             p_parent: jnp.ndarray       # (W,) i32  parent slot (-1 empty)
             p_small: jnp.ndarray        # (W,) i32  leaf whose hist is fresh
             p_large: jnp.ndarray        # (W,) i32  sibling (subtraction)
 
-        # slot L of hist/best is a junk row absorbing writes for empty
-        # pending slots, so vector scatters never collide with live leaves
+        # every per-leaf array carries one junk slot (index L; records:
+        # index L-1) absorbing vector-scatter writes from empty lanes, so
+        # scatters never collide with live leaves
         neg = jnp.full((L + 1, 13), NEG_INF, jnp.float32)
         init = _S(
             leaf_id=leaf_id0,
             hist=jnp.zeros((L + 1, S, 3), jnp.float32),
-            total=jnp.zeros((L, 3), jnp.float32),
-            value=jnp.zeros((L,), jnp.float32),
-            depth=jnp.zeros((L,), jnp.int32),
+            total=jnp.zeros((L + 1, 3), jnp.float32),
+            value=jnp.zeros((L + 1,), jnp.float32),
+            depth=jnp.zeros((L + 1,), jnp.int32),
             best=neg,
             nl=jnp.asarray(1, jnp.int32),
             done=jnp.asarray(False),
-            rec_i=jnp.full((max(L - 1, 1), REC_I_FIELDS), -1, jnp.int32),
-            rec_f=jnp.zeros((max(L - 1, 1), REC_F_FIELDS), jnp.float32),
+            rec_i=jnp.full((L, REC_I_FIELDS), -1, jnp.int32),
+            rec_f=jnp.zeros((L, REC_F_FIELDS), jnp.float32),
             p_parent=jnp.full((W,), -1, jnp.int32),
             p_small=jnp.concatenate([jnp.zeros(1, jnp.int32),
                                      jnp.full((W - 1,), -1, jnp.int32)])
@@ -235,7 +254,8 @@ class DeviceGrower:
 
         def wave(st: _S) -> _S:
             # 1. fresh histograms for pending smaller children
-            fresh = self._wave_hist(st.leaf_id, gh5, st.p_small)  # (W,S,3)
+            fresh = self._wave_hist(binned, st.leaf_id, gh5,
+                                    st.p_small)               # (W,S,3)
             root_wave = st.p_parent[0] < 0
             # root total from group-0 slot sums (every row hits one slot)
             root_total = fresh[0, :self.nb, :].sum(0)
@@ -282,78 +302,82 @@ class DeviceGrower:
             napply = sel.sum().astype(jnp.int32)
             rank = jnp.cumsum(sel.astype(jnp.int32)) - 1
 
-            # 5. apply the selected splits sequentially (dense leaf_id
-            # update per split; O(n) elementwise over a contiguous column)
-            def apply_one(w, carry):
-                (leaf_id, total, value, depth, best, rec_i, rec_f,
-                 pp, ps, pl) = carry
+            # 5. apply all selected splits at once.  Selected leaves are
+            # distinct (top_k) and so are the new right ids, so scatters
+            # can't collide; invalid lanes are routed to the junk rows.
+            lsel = top_idx.astype(jnp.int32)                  # (W,)
+            vecs = best[lsel]                                 # (W,13)
+            r_ids = st.nl + rank                              # (W,)
+            f = vecs[:, F_FEATURE].astype(jnp.int32)
+            thr = vecs[:, F_THRESHOLD].astype(jnp.int32)
+            dl = vecs[:, F_DEFAULT_LEFT] > 0.5
+            grp = self.p_group[f]
+            off = self.p_offset[f]
+            wid = self.p_width[f]
+            db = self.p_default_bin[f]
+            nbin = self.p_num_bin[f]
+            miss = self.p_missing[f]
+            def_left = jnp.where(miss == 1, dl, db <= thr)    # (W,)
 
-                def do(args):
-                    (leaf_id, total, value, depth, best, rec_i, rec_f,
-                     pp, ps, pl) = args
-                    l = top_idx[w]
-                    r = st.nl + rank[w]
-                    vec = best[l]
-                    f = vec[F_FEATURE].astype(jnp.int32)
-                    thr = vec[F_THRESHOLD].astype(jnp.int32)
-                    dl = vec[F_DEFAULT_LEFT] > 0.5
-                    # partition: route rows of leaf l
-                    grp = self.p_group[f]
-                    off = self.p_offset[f]
-                    width = self.p_width[f]
-                    db = self.p_default_bin[f]
-                    nbin = self.p_num_bin[f]
-                    miss = self.p_missing[f]
-                    col = jax.lax.dynamic_slice(
-                        self.binned_t, (grp, 0), (1, n))[0].astype(jnp.int32)
-                    shift = jnp.where(db == 0, 1, 0)
-                    in_range = (col >= off) & (col < off + width)
-                    bin_ = jnp.where(in_range, col - off + shift, db)
-                    is_default = bin_ == db
-                    is_na = (miss == 2) & (bin_ == nbin - 1)
-                    def_left = jnp.where(miss == 1, dl, db <= thr)
-                    goes_left = jnp.where(
-                        is_default, def_left,
-                        jnp.where(is_na, dl, bin_ <= thr))
-                    mine = leaf_id == l
-                    leaf_id = jnp.where(mine & ~goes_left, r, leaf_id)
+            # leaf_id update: one fused dense pass over contiguous (G, N)
+            # feature rows; masks are disjoint (a row belongs to at most
+            # one selected leaf)
+            upd = jnp.zeros((n,), jnp.int32)
+            for w in range(W):
+                colw = jax.lax.dynamic_slice(
+                    binned_t, (grp[w], 0), (1, n))[0].astype(jnp.int32)
+                shift = jnp.where(db[w] == 0, 1, 0)
+                in_range = (colw >= off[w]) & (colw < off[w] + wid[w])
+                bin_ = jnp.where(in_range, colw - off[w] + shift, db[w])
+                is_default = bin_ == db[w]
+                is_na = (miss[w] == 2) & (bin_ == nbin[w] - 1)
+                goes_left = jnp.where(is_default, def_left[w],
+                                      jnp.where(is_na, dl[w],
+                                                bin_ <= thr[w]))
+                mask = sel[w] & (st.leaf_id == lsel[w]) & ~goes_left
+                upd = upd + jnp.where(mask, r_ids[w] - lsel[w], 0)
+            leaf_id = st.leaf_id + upd
 
-                    lsum = jnp.stack([vec[F_LEFT_G], vec[F_LEFT_H],
-                                      vec[F_LEFT_C]])
-                    rsum = jnp.stack([vec[F_RIGHT_G], vec[F_RIGHT_H],
-                                      vec[F_RIGHT_C]])
-                    total = total.at[l].set(lsum)
-                    total = total.at[r].set(rsum)
-                    value = value.at[l].set(vec[F_LEFT_OUT])
-                    value = value.at[r].set(vec[F_RIGHT_OUT])
-                    d = depth[l] + 1
-                    depth = depth.at[l].set(d).at[r].set(d)
-                    small_left = vec[F_LEFT_C] <= vec[F_RIGHT_C]
-                    s_leaf = jnp.where(small_left, l, r)
-                    b_leaf = jnp.where(small_left, r, l)
-                    k = rank[w]
-                    pp = pp.at[k].set(l)
-                    ps = ps.at[k].set(s_leaf)
-                    pl = pl.at[k].set(b_leaf)
-                    best = best.at[l].set(neg[0]).at[r].set(neg[0])
-                    ridx = st.nl - 1 + k
-                    rec_i = rec_i.at[ridx].set(jnp.stack(
-                        [l, r, f, thr, dl.astype(jnp.int32)]))
-                    rec_f = rec_f.at[ridx].set(jnp.stack(
-                        [vec[F_GAIN], vec[F_LEFT_G], vec[F_LEFT_H],
-                         vec[F_LEFT_C], vec[F_RIGHT_G], vec[F_RIGHT_H],
-                         vec[F_RIGHT_C], vec[F_LEFT_OUT],
-                         vec[F_RIGHT_OUT]]))
-                    return (leaf_id, total, value, depth, best, rec_i,
-                            rec_f, pp, ps, pl)
-
-                return jax.lax.cond(sel[w], do, lambda a: a, carry)
-
-            pp0 = jnp.full((W,), -1, jnp.int32)
-            carry = (st.leaf_id, total, value, st.depth, best,
-                     st.rec_i, st.rec_f, pp0, pp0, pp0)
-            (leaf_id, total, value, depth, best, rec_i, rec_f,
-             pp, ps, pl) = jax.lax.fori_loop(0, W, apply_one, carry)
+            # bookkeeping (vectorized scatters into the L-padded arrays)
+            safe_l = jnp.where(sel, lsel, L)
+            safe_r = jnp.where(sel, r_ids, L)
+            lsum = vecs[:, jnp.asarray([F_LEFT_G, F_LEFT_H, F_LEFT_C])]
+            rsum = vecs[:, jnp.asarray([F_RIGHT_G, F_RIGHT_H, F_RIGHT_C])]
+            total = total.at[safe_l].set(
+                jnp.where(sel[:, None], lsum, total[safe_l]))
+            total = total.at[safe_r].set(
+                jnp.where(sel[:, None], rsum, total[safe_r]))
+            value = value.at[safe_l].set(
+                jnp.where(sel, vecs[:, F_LEFT_OUT], value[safe_l]))
+            value = value.at[safe_r].set(
+                jnp.where(sel, vecs[:, F_RIGHT_OUT], value[safe_r]))
+            child_d = st.depth[jnp.clip(lsel, 0, L)] + 1
+            depth = st.depth.at[safe_l].set(
+                jnp.where(sel, child_d, st.depth[safe_l]))
+            depth = depth.at[safe_r].set(
+                jnp.where(sel, child_d, depth[safe_r]))
+            best = best.at[safe_l].set(
+                jnp.where(sel[:, None], neg[0][None, :], best[safe_l]))
+            best = best.at[safe_r].set(
+                jnp.where(sel[:, None], neg[0][None, :], best[safe_r]))
+            # split records (rows are padded by one junk row at index L-1)
+            ridx = jnp.where(sel, st.nl - 1 + rank, L - 1)
+            new_ri = jnp.stack([lsel, r_ids, f, thr,
+                                dl.astype(jnp.int32)], axis=1)
+            new_rf = jnp.stack(
+                [vecs[:, F_GAIN], vecs[:, F_LEFT_G], vecs[:, F_LEFT_H],
+                 vecs[:, F_LEFT_C], vecs[:, F_RIGHT_G], vecs[:, F_RIGHT_H],
+                 vecs[:, F_RIGHT_C], vecs[:, F_LEFT_OUT],
+                 vecs[:, F_RIGHT_OUT]], axis=1)
+            rec_i = st.rec_i.at[ridx].set(
+                jnp.where(sel[:, None], new_ri, st.rec_i[ridx]))
+            rec_f = st.rec_f.at[ridx].set(
+                jnp.where(sel[:, None], new_rf, st.rec_f[ridx]))
+            # pending for the next wave
+            small_left = vecs[:, F_LEFT_C] <= vecs[:, F_RIGHT_C]
+            pp = jnp.where(sel, lsel, -1)
+            ps = jnp.where(sel, jnp.where(small_left, lsel, r_ids), -1)
+            pl = jnp.where(sel, jnp.where(small_left, r_ids, lsel), -1)
 
             return _S(leaf_id=leaf_id, hist=hist, total=total, value=value,
                       depth=depth, best=best, nl=st.nl + napply,
@@ -364,25 +388,23 @@ class DeviceGrower:
             return (~st.done) & (st.nl < L)
 
         final = jax.lax.while_loop(cond, wave, init)
-
-        # one evaluation round may still be pending when the loop exits on
-        # budget; nothing to do — those leaves just stay leaves.
+        leaf_final = final.leaf_id
 
         # score update: score[row] += lr * value[leaf_id[row]] via one-hot
         # matmul (hi/lo split keeps f32-level precision at bf16 speed).
         # A stump (root never split) applies nothing: the boosting driver
         # treats it as the stop signal, matching GBDT::TrainOneIter.
-        scaled = final.value * lr * (final.nl > 1)
+        scaled = final.value[:L] * lr * (final.nl > 1)
         vhi = scaled.astype(jnp.bfloat16)
         vlo = (scaled - vhi.astype(jnp.float32)).astype(jnp.bfloat16)
         vmat = jnp.stack([vhi, vlo], 1)                       # (L, 2)
-        oh = jax.nn.one_hot(final.leaf_id, L, dtype=jnp.bfloat16)
+        oh = jax.nn.one_hot(leaf_final, L, dtype=jnp.bfloat16)
         upd = jnp.einsum("nl,lk->nk", oh, vmat,
                          preferred_element_type=jnp.float32)
         new_score = score + (upd[:, 0] + upd[:, 1])[:self.num_data]
 
-        return (new_score, final.rec_i, final.rec_f, final.nl,
-                final.value[0])
+        return (new_score, final.rec_i[:max(L - 1, 1)],
+                final.rec_f[:max(L - 1, 1)], final.nl, final.value[0])
 
     # ------------------------------------------------------------------
     def grow_one_iter(self, score, grad, hess, feature_mask, lr=None):
@@ -391,8 +413,8 @@ class DeviceGrower:
         """
         if lr is None:
             lr = self.lr
-        return self._grow(score, grad, hess, feature_mask,
-                          jnp.asarray(lr, jnp.float32))
+        return self._grow(self.binned, self.binned_t, score, grad, hess,
+                          feature_mask, jnp.asarray(lr, jnp.float32))
 
 
 def device_growth_eligible(config, dataset, objective, num_model) -> bool:
