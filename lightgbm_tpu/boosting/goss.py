@@ -5,7 +5,6 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..ops.bagging import goss_partition
 from ..utils.log import LightGBMError
 from .gbdt import GBDT
 
@@ -20,18 +19,15 @@ class GOSS(GBDT):
         cfg = self.config
         if cfg.top_rate + cfg.other_rate > 1.0:
             raise LightGBMError("top_rate + other_rate <= 1.0 in GOSS")
-        from ..parallel.data_parallel import DataParallelTreeLearner
-        if isinstance(self.learner, DataParallelTreeLearner):
-            # GOSS selection is a global top-k over one permutation buffer;
-            # the row-sharded learners need a per-shard variant (planned)
-            raise LightGBMError(
-                "boosting=goss with tree_learner=data/voting is not "
-                "supported yet; use tree_learner=feature or serial")
         self.need_bagging = False      # GOSS replaces bagging
         self._goss_multiplier = None
         self.is_constant_hessian = False
 
     def bagging(self, it: int):
+        """GOSS selection through the learner's ``goss_state`` hook: the
+        serial/feature learners select over the full permutation buffer,
+        the row-sharded learners (data/voting) per shard - matching the
+        reference's rank-local GOSS (goss.hpp:88-133)."""
         self.bag_buffer = None
         self.bag_count = self.num_data
         self._goss_multiplier = None
@@ -39,18 +35,12 @@ class GOSS(GBDT):
             return
         grad, hess = self._cur_grad
         score = jnp.abs(grad * hess).sum(axis=0)
-        n_pad = self.learner.n_pad
-        pad = n_pad - self.num_data
-        if pad > 0:
-            score = jnp.concatenate([score, jnp.zeros(pad, jnp.float32)])
-        key = jax.random.PRNGKey((self.config.bagging_seed + it) & 0x7FFFFFFF)
-        buf, cnt, mult = goss_partition(
-            key, score, n_pad, jnp.asarray(self.num_data, jnp.int32),
-            jnp.asarray(self.config.top_rate, jnp.float32),
-            jnp.asarray(self.config.other_rate, jnp.float32))
+        seed = (self.config.bagging_seed + it) & 0x7FFFFFFF
+        buf, cnt, mult = self.learner.goss_state(
+            seed, score, self.config.top_rate, self.config.other_rate)
         self.bag_buffer = buf
-        self.bag_count = int(cnt)
-        self._goss_multiplier = mult[:self.num_data]
+        self.bag_count = cnt
+        self._goss_multiplier = mult
 
     def _adjust_gradients(self, grad, hess):
         # stash for bagging(); multiplier applied after selection

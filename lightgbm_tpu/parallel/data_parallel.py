@@ -129,6 +129,69 @@ class DataParallelTreeLearner(SerialTreeLearner):
         counts_np = np.asarray(counts)
         return (buf, counts_np), int(counts_np.sum())
 
+    def goss_state(self, seed: int, score_abs, top_rate: float,
+                   other_rate: float):
+        """Rank-local GOSS: each shard takes its own top |g*h| rows and
+        samples the rest with its own counts, matching the reference's
+        GOSS over rank-local rows (goss.hpp:88-133 with pre-partitioned
+        data).  Returns the (buffer, counts) state the DP ``_init_state``
+        consumes, the global selected count, and the (N,) multiplier."""
+        if getattr(self, "_goss_fn", None) is None:
+            net = self.net
+            n_loc = self.n_loc
+
+            @jax.jit
+            @functools.partial(jax.shard_map, mesh=net.mesh,
+                               in_specs=(self._rep_spec, self._row_spec,
+                                         self._row_spec, self._rep_spec,
+                                         self._rep_spec),
+                               out_specs=(self._row_spec, self._row_spec,
+                                          self._row_spec),
+                               check_vma=False)
+            def _goss(key, score, n_valid, top_rate, other_rate):
+                w = jax.lax.axis_index(net.axis)
+                k = jax.random.fold_in(key, w)
+                nv = n_valid[0]
+                pos = jnp.arange(n_loc, dtype=jnp.int32)
+                valid = pos < nv
+                scores = jnp.where(valid, score, -jnp.inf)
+                top_k = jnp.maximum(
+                    (nv.astype(jnp.float32) * top_rate).astype(jnp.int32),
+                    1)
+                other_k = jnp.maximum(
+                    (nv.astype(jnp.float32) * other_rate).astype(jnp.int32),
+                    1)
+                sorted_desc = jnp.sort(scores)[::-1]
+                threshold = sorted_desc[jnp.clip(top_k - 1, 0, n_loc - 1)]
+                is_top = valid & (score >= threshold)
+                rest = valid & ~is_top
+                n_rest = jnp.maximum(rest.sum(), 1)
+                prob = other_k.astype(jnp.float32) \
+                    / n_rest.astype(jnp.float32)
+                u = jax.random.uniform(k, (n_loc,))
+                sampled = rest & (u < prob)
+                selected = is_top | sampled
+                mult = jnp.where(
+                    sampled,
+                    (nv - top_k).astype(jnp.float32)
+                    / other_k.astype(jnp.float32), 1.0)
+                sort_key = jnp.where(selected, 0, jnp.where(valid, 1, 2))
+                order = jnp.argsort(sort_key.astype(jnp.int32), stable=True)
+                return (order.astype(jnp.int32),
+                        jnp.broadcast_to(
+                            selected.sum().astype(jnp.int32), (1,)),
+                        mult)
+
+            self._goss_fn = _goss
+        score_pad = self._pad_rows(jnp.asarray(score_abs, jnp.float32))
+        buf, counts, mult = self._goss_fn(
+            jax.random.PRNGKey(seed), score_pad, self._n_valid_dev,
+            jnp.asarray(top_rate, jnp.float32),
+            jnp.asarray(other_rate, jnp.float32))
+        counts_np = np.asarray(counts)
+        return ((buf, counts_np), int(counts_np.sum()),
+                jnp.asarray(mult)[:self.num_data])
+
     def _init_state(self, indices_buffer, data_count, grad, hess):
         if indices_buffer is None:
             buffer = self._full_buffer

@@ -190,3 +190,19 @@ def test_voting_small_k_quality(binary_data):
                           "top_k": 5}, x, y, 15, valid=(xt, yt))
     res = dict((n, v) for _, n, v, _ in bst.eval_valid())
     assert res["auc"] > 0.74, res
+
+
+@pytest.mark.parametrize("kind", ["data", "voting"])
+def test_goss_under_row_sharded_learners(binary_data, kind):
+    """Per-shard GOSS (rank-local top-k, reference goss.hpp:88-133) must
+    reach the serial-GOSS quality level on the binary fixture."""
+    x, y, xt, yt = binary_data
+    base = {"objective": "binary", "metric": "auc", "boosting": "goss",
+            "num_leaves": 15, "learning_rate": 0.1, "top_rate": 0.3,
+            "other_rate": 0.2, "top_k": 40}
+    serial = _train_boosted(base, x, y, 25, valid=(xt, yt))
+    par = _train_boosted(dict(base, tree_learner=kind, num_machines=8),
+                         x, y, 25, valid=(xt, yt))
+    auc_s = dict((n, v) for _, n, v, _ in serial.eval_valid())["auc"]
+    auc_p = dict((n, v) for _, n, v, _ in par.eval_valid())["auc"]
+    assert auc_p > auc_s - 0.01, (auc_s, auc_p)
