@@ -129,6 +129,12 @@ class GBDT:
             self.num_model = max(int(cfg.num_class), 1)
             self.class_need_train = [True] * self.num_model
         self.learner = create_tree_learner(cfg, train_set)
+        if getattr(cfg, "forcedsplits_filename", ""):
+            import json
+            with open(cfg.forcedsplits_filename) as fh:
+                self.learner.forced_splits = json.load(fh)
+            log_info(f"Loaded forced splits from "
+                     f"{cfg.forcedsplits_filename}")
         n = train_set.num_data
         self.num_data = n
         self.train_score = jnp.zeros((self.num_model, n), jnp.float32)
@@ -473,6 +479,30 @@ class GBDT:
 
     # ------------------------------------------------------------------
     # prediction (raw host data)
+    def _early_stop_instance(self):
+        """Row-wise prediction early stopping
+        (src/boosting/prediction_early_stop.cpp:1-89): binary stops a row
+        once 2*|margin| exceeds the threshold, multiclass once the top-two
+        class margin does; checked every ``pred_early_stop_freq`` trees."""
+        cfg = self.config
+        if not getattr(cfg, "pred_early_stop", False):
+            return None
+        obj_name = (self.objective.name if self.objective is not None
+                    else (self.loaded_objective_str.split()[0]
+                          if self.loaded_objective_str else ""))
+        margin = float(cfg.pred_early_stop_margin)
+        freq = max(int(cfg.pred_early_stop_freq), 1)
+        if obj_name.startswith("binary") and self.num_model == 1:
+            return freq, lambda out: 2.0 * np.abs(out[0]) > margin
+        if self.num_model > 1:
+            def mc(out):
+                part = np.partition(out, self.num_model - 2, axis=0)
+                return part[-1] - part[-2] > margin
+            return freq, mc
+        log_warning("pred_early_stop is only supported for binary and "
+                    "multiclass objectives; ignoring")
+        return None
+
     def predict_raw(self, data: np.ndarray, num_iteration: int = -1,
                     start_iteration: int = 0) -> np.ndarray:
         self._flush_pending()
@@ -482,10 +512,22 @@ class GBDT:
         total_iter = self.num_iterations()
         end_iter = total_iter if num_iteration <= 0 \
             else min(start_iteration + num_iteration, total_iter)
+        early = self._early_stop_instance()
+        active = None if early is None else np.ones(n, bool)
         for it in range(start_iteration, end_iter):
             for k in range(self.num_model):
                 tree = self.models[it * self.num_model + k]
-                out[k] += tree.predict(data)
+                if active is None:
+                    out[k] += tree.predict(data)
+                elif active.all():
+                    out[k] += tree.predict(data)
+                else:
+                    out[k, active] += tree.predict(data[active])
+            if early is not None and (it + 1 - start_iteration) \
+                    % early[0] == 0:
+                active &= ~early[1](out)
+                if not active.any():
+                    break
         if self.average_output and end_iter > start_iteration:
             out /= (end_iter - start_iteration)
         return out

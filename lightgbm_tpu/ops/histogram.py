@@ -33,7 +33,8 @@ def num_chunks_for(m: int) -> int:
     return m // _CHUNK if (m > _CHUNK and m % _CHUNK == 0) else 1
 
 
-def _chunk_histogram(bins_u8: jnp.ndarray, gh: jnp.ndarray) -> jnp.ndarray:
+def _chunk_histogram(bins_u8: jnp.ndarray, gh: jnp.ndarray,
+                     dp: bool = False) -> jnp.ndarray:
     """(C, G) uint8 bins x (C, 3) [g, h, 1] -> (G, 256, 3) partial sums.
 
     TPU: one-hot matmul on the MXU.  Precision HIGHEST keeps the gradient
@@ -44,6 +45,10 @@ def _chunk_histogram(bins_u8: jnp.ndarray, gh: jnp.ndarray) -> jnp.ndarray:
     CPU (tests / virtual mesh): XLA CPU would materialise the one-hot and
     run the f32 matmul through the slow 6-pass emulation, so use a
     scatter-add instead — same result, ~100x faster there.
+
+    ``dp`` is unused at chunk level (kept for signature symmetry); the
+    double-precision option acts on the cross-chunk accumulation, see
+    ``_histogram_scan``.
     """
     if jax.default_backend() == "tpu":
         oh = jax.nn.one_hot(bins_u8, 256, dtype=jnp.float32)  # (C, G, 256)
@@ -61,21 +66,55 @@ def _chunk_histogram(bins_u8: jnp.ndarray, gh: jnp.ndarray) -> jnp.ndarray:
     return hist.reshape(g, 256, 3)
 
 
-@functools.partial(jax.jit, static_argnames=("num_chunks",))
+@functools.partial(jax.jit, static_argnames=("num_chunks", "dp"))
 def _histogram_scan(bins: jnp.ndarray, gh: jnp.ndarray,
-                    num_chunks: int) -> jnp.ndarray:
+                    num_chunks: int, dp: bool = False) -> jnp.ndarray:
+    """Chunked histogram accumulation.
+
+    ``dp`` realises the reference's ``gpu_use_dp``
+    (gpu_tree_learner.h:73-77): double-precision-equivalent accumulation
+    without x64 (JAX runs with it disabled).  Two ingredients: the
+    accumulation granule shrinks to 512 rows, so each partial sum is
+    accurate in f32, and the cross-granule running total is Kahan
+    compensated, keeping the final error O(ulp) instead of
+    O(num_granules * ulp(total)) — the billion-row f32 accumulation
+    concern from SURVEY §7.  Costs extra scan steps; accuracy mode only.
+    """
     g = bins.shape[1]
-    if num_chunks == 1:
-        return _chunk_histogram(bins, gh)
-    bins_c = bins.reshape(num_chunks, -1, g)
-    gh_c = gh.reshape(num_chunks, -1, 3)
+    if num_chunks == 1 and not dp:
+        return _chunk_histogram(bins, gh, dp)
 
-    def body(acc, xs):
+    if not dp:
+        bins_c = bins.reshape(num_chunks, -1, g)
+        gh_c = gh.reshape(num_chunks, -1, 3)
+
+        def body(acc, xs):
+            b, w = xs
+            return acc + _chunk_histogram(b, w), None
+
+        init = jnp.zeros((g, 256, 3), jnp.float32)
+        acc, _ = jax.lax.scan(body, init, (bins_c, gh_c))
+        return acc
+
+    rows = bins.shape[0]
+    sub = 512
+    n_sub = max(rows // sub, 1)
+    if rows % sub:                       # odd tail: single compensated step
+        n_sub, sub = 1, rows
+    bins_c = bins.reshape(n_sub, sub, g)
+    gh_c = gh.reshape(n_sub, sub, 3)
+
+    def body_kahan(carry, xs):
+        acc, comp = carry
         b, w = xs
-        return acc + _chunk_histogram(b, w), None
+        h = _chunk_histogram(b, w)
+        y = h - comp
+        t = acc + y
+        comp = (t - acc) - y
+        return (t, comp), None
 
-    init = jnp.zeros((g, 256, 3), jnp.float32)
-    acc, _ = jax.lax.scan(body, init, (bins_c, gh_c))
+    z = jnp.zeros((g, 256, 3), jnp.float32)
+    (acc, _), _ = jax.lax.scan(body_kahan, (z, z), (bins_c, gh_c))
     return acc
 
 

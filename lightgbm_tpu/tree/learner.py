@@ -63,13 +63,13 @@ class SplitParams(NamedTuple):
     cat_member: np.ndarray    # (256,) bool
 
 
-@functools.partial(jax.jit, static_argnames=("m", "num_chunks"))
+@functools.partial(jax.jit, static_argnames=("m", "num_chunks", "dp"))
 def _window_histogram(binned, grad, hess, buffer, begin, start, count, m,
-                      num_chunks):
+                      num_chunks, dp=False):
     """Fused slice + gather + histogram for one leaf window."""
     win = jax.lax.dynamic_slice(buffer, (begin,), (m,))
     bins, gh = _gather_rows(binned, grad, hess, win, start, count)
-    return _histogram_scan(bins, gh, num_chunks)
+    return _histogram_scan(bins, gh, num_chunks, dp)
 
 
 @functools.partial(jax.jit, static_argnames=("m",), donate_argnums=(1,))
@@ -125,6 +125,8 @@ class SerialTreeLearner:
             (config.feature_fraction_seed if config.feature_fraction_seed
              else config.seed + 2) & 0x7FFFFFFF)
         self.forced_splits = None   # parsed forced-split JSON (dict) or None
+        # reference gpu_use_dp: double-precision-equivalent accumulation
+        self._dp = bool(getattr(config, "gpu_use_dp", False))
 
     @property
     def traverse_binned(self):
@@ -201,7 +203,7 @@ class SerialTreeLearner:
                                 jnp.asarray(b, jnp.int32),
                                 jnp.asarray(start, jnp.int32),
                                 jnp.asarray(info.count, jnp.int32), m,
-                                num_chunks)
+                                num_chunks, self._dp)
         return TRAIN_TIMER.stop_sync("hist", out)
 
     def _leaf_totals(self, hist) -> np.ndarray:
@@ -272,9 +274,12 @@ class SerialTreeLearner:
         self._schedule_find_best(root, feature_mask)
 
         forced_queue = self._init_forced(tree)
+        if forced_queue:
+            self._run_forced(tree, leaves, forced_queue, grad, hess,
+                             feature_mask)
 
-        for _ in range(cfg.num_leaves - 1):
-            best_leaf, best = self._pick_best_leaf(leaves, forced_queue)
+        while len(leaves) < cfg.num_leaves:
+            best_leaf, best = self._pick_best_leaf(leaves, None)
             if best_leaf is None:
                 break
             self._apply_split(tree, leaves, best_leaf, best, grad, hess,
@@ -423,11 +428,92 @@ class SerialTreeLearner:
     # ------------------------------------------------------------------
     # forced splits (reference ForceSplits, serial_tree_learner.cpp:546-701)
     def _init_forced(self, tree):
+        """Returns the BFS queue of (leaf, spec-dict) forced splits."""
         if not self.forced_splits:
             return []
-        log_warning("forcedsplits are not supported by the TPU learner yet; "
-                    "ignoring forced split file")
-        return []
+        return [(0, self.forced_splits)]
+
+    def _run_forced(self, tree, leaves, forced_queue, grad, hess,
+                    feature_mask):
+        """BFS-apply the forced-split JSON before best-gain growth
+        (reference ForceSplits).  A branch whose forced split is invalid
+        (unused feature, min_data/min_hessian violation) is abandoned with
+        a warning, like the reference's CHECK-and-skip behaviour."""
+        cfg = self.config
+        while forced_queue and len(leaves) < cfg.num_leaves:
+            leaf, spec = forced_queue.pop(0)
+            right = self._apply_forced_split(tree, leaves, leaf, spec,
+                                             grad, hess, feature_mask)
+            if right is None:
+                continue
+            if isinstance(spec.get("left"), dict):
+                forced_queue.append((leaf, spec["left"]))
+            if isinstance(spec.get("right"), dict):
+                forced_queue.append((right, spec["right"]))
+
+    def _apply_forced_split(self, tree, leaves, leaf, spec, grad, hess,
+                            feature_mask):
+        ds = self.dataset
+        cfg = self.config
+        info = leaves[leaf]
+        real_f = int(spec.get("feature", -1))
+        try:
+            fi = ds.used_features.index(real_f)
+        except ValueError:
+            log_warning(f"forced split on unused feature {real_f}; "
+                        f"skipping branch")
+            return None
+        if info.hist is None or not self._splittable(info):
+            return None
+        mapper = ds.bin_mappers[real_f]
+        if bool(ds.f_is_categorical[fi]):
+            log_warning("forced categorical splits are not supported; "
+                        "skipping branch")
+            return None
+        thr_bin = int(mapper.value_to_bin(float(spec["threshold"])))
+        nb = int(ds.f_num_bin[fi])
+        db = int(ds.f_default_bin[fi])
+        miss = int(ds.f_missing_type[fi])
+        thr_bin = min(thr_bin, nb - 2) if nb > 1 else 0
+        # feature histogram with the default bin reconstructed
+        flat = np.asarray(info.hist, np.float64).reshape(-1, 3)
+        grp = int(ds.f_group[fi])
+        off = int(ds.f_offset[fi])
+        shift = 1 if db == 0 else 0
+        fh = np.zeros((256, 3), np.float64)
+        for b in range(nb):
+            if b != db:
+                fh[b] = flat[grp * 256 + off + b - shift]
+        fh[db] = np.maximum(info.total - fh[:nb].sum(0) + fh[db], 0.0)
+        # left = bins <= thr (partition-kernel semantics, default_left
+        # False: the NaN bin goes right)
+        left_bins = np.arange(nb) <= thr_bin
+        if miss == 2:
+            left_bins[nb - 1] = False
+        left = fh[:nb][left_bins].sum(0)
+        right_sum = info.total - left
+        if (left[2] < cfg.min_data_in_leaf
+                or right_sum[2] < cfg.min_data_in_leaf
+                or left[1] < cfg.min_sum_hessian_in_leaf
+                or right_sum[1] < cfg.min_sum_hessian_in_leaf):
+            log_warning(f"forced split on feature {real_f} violates "
+                        f"min_data/min_hessian constraints; skipping branch")
+            return None
+        left_out = self._leaf_output(left[0], left[1])
+        right_out = self._leaf_output(right_sum[0], right_sum[1])
+        vec = np.zeros(13, np.float32)
+        vec[F_GAIN] = 0.0
+        vec[F_FEATURE] = fi
+        vec[F_THRESHOLD] = thr_bin
+        vec[F_DEFAULT_LEFT] = 0.0
+        vec[F_IS_CAT] = 0.0
+        vec[F_LEFT_G], vec[F_LEFT_H], vec[F_LEFT_C] = left
+        vec[F_RIGHT_G], vec[F_RIGHT_H], vec[F_RIGHT_C] = right_sum
+        vec[F_LEFT_OUT] = left_out
+        vec[F_RIGHT_OUT] = right_out
+        return self._apply_split(tree, leaves, leaf,
+                                 (vec, np.zeros(256, bool)), grad, hess,
+                                 feature_mask, forced=True)
 
     # ------------------------------------------------------------------
     def leaf_regions(self):

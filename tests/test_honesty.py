@@ -1,0 +1,132 @@
+"""Previously accepted-but-ignored parameters now do what they say:
+multiclass init_score validation, prediction early stopping
+(prediction_early_stop.cpp), forced splits (ForceSplits,
+serial_tree_learner.cpp:546-701), gpu_use_dp accumulation."""
+
+import json
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.boosting import create_boosting
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.data.dataset import BinnedDataset
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def _dataset(params, x, y, init_score=None):
+    cfg = Config(params)
+    ds = BinnedDataset.construct_from_matrix(x, cfg)
+    ds.metadata.set_label(y)
+    if init_score is not None:
+        ds.metadata.set_init_score(init_score)
+    return cfg, ds
+
+
+@pytest.fixture(scope="module")
+def mc_data():
+    rng = np.random.default_rng(0)
+    n = 3000
+    x = rng.standard_normal((n, 6)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(int) \
+        + (x[:, 2] > 0.8).astype(int)
+    return x, y.astype(np.float32)
+
+
+def test_multiclass_init_score_wrong_size_rejected(mc_data):
+    x, y = mc_data
+    cfg, ds = _dataset({"objective": "multiclass", "num_class": 3}, x, y,
+                       init_score=np.zeros(len(y)))  # must be 3*N
+    bst = create_boosting(cfg)
+    with pytest.raises(LightGBMError, match="Initial score size"):
+        bst.init_train(ds)
+
+
+def test_multiclass_init_score_full_size_used(mc_data):
+    x, y = mc_data
+    n = len(y)
+    init = np.zeros(3 * n)
+    init[:n] = 2.0      # class 0 biased up (class-major layout)
+    cfg, ds = _dataset({"objective": "multiclass", "num_class": 3}, x, y,
+                       init_score=init)
+    bst = create_boosting(cfg)
+    bst.init_train(ds)
+    score = np.asarray(bst.train_score)
+    assert score.shape == (3, n)
+    assert np.allclose(score[0], 2.0) and np.allclose(score[1:], 0.0)
+
+
+def test_prediction_early_stopping(mc_data):
+    rng = np.random.default_rng(1)
+    n = 4000
+    x = rng.standard_normal((n, 6)).astype(np.float32)
+    y = (x[:, 0] + 0.3 * rng.standard_normal(n) > 0).astype(np.float32)
+    cfg, ds = _dataset({"objective": "binary", "num_leaves": 15,
+                        "learning_rate": 0.3}, x, y)
+    bst = create_boosting(cfg)
+    bst.init_train(ds)
+    for _ in range(30):
+        bst.train_one_iter()
+    full = bst.predict(x[:500], raw_score=True)
+    # huge margin -> identical predictions
+    bst.config.pred_early_stop = True
+    bst.config.pred_early_stop_margin = 1e10
+    bst.config.pred_early_stop_freq = 5
+    same = bst.predict(x[:500], raw_score=True)
+    np.testing.assert_allclose(full, same)
+    # tiny margin -> rows freeze after the first check period
+    bst.config.pred_early_stop_margin = 0.0
+    stopped = bst.predict(x[:500], raw_score=True)
+    assert not np.allclose(full, stopped)
+    short = bst.predict(x[:500], raw_score=True, num_iteration=5)
+    np.testing.assert_allclose(stopped, short)
+    bst.config.pred_early_stop = False
+
+
+def test_forced_splits(tmp_path, mc_data):
+    rng = np.random.default_rng(2)
+    n = 4000
+    x = rng.standard_normal((n, 5)).astype(np.float32)
+    y = (x[:, 3] * 2.0 + 0.1 * rng.standard_normal(n)).astype(np.float32)
+    forced = {"feature": 2, "threshold": 0.0,
+              "left": {"feature": 4, "threshold": 0.5}}
+    path = tmp_path / "forced.json"
+    path.write_text(json.dumps(forced))
+    cfg, ds = _dataset({"objective": "regression", "num_leaves": 15,
+                        "forcedsplits_filename": str(path)}, x, y)
+    bst = create_boosting(cfg)
+    bst.init_train(ds)
+    bst.train_one_iter()
+    tree = bst.models[0]
+    # node 0 must split feature 2 at ~0.0; its left child on feature 4
+    assert int(tree.split_feature[0]) == 2
+    assert abs(float(tree.threshold[0])) < 0.1
+    left = int(tree.left_child[0])
+    assert left >= 0 and int(tree.split_feature[left]) == 4
+    assert abs(float(tree.threshold[left]) - 0.5) < 0.15
+    # model text round-trips with the forced structure intact
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    loaded = GBDT.load_model_from_string(bst.model_to_string())
+    np.testing.assert_allclose(loaded.predict(x[:100], raw_score=True),
+                               bst.predict(x[:100], raw_score=True),
+                               atol=1e-6)
+
+
+def test_gpu_use_dp_accumulation():
+    """gpu_use_dp = Kahan compensation across histogram chunks: once the
+    running total dwarfs a chunk's contribution, plain f32 accumulation
+    drifts by O(num_chunks * ulp(total)) while the compensated sum stays
+    within one ulp (the SURVEY §7 billion-row accumulation concern)."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.histogram import _histogram_scan
+    n = 512 * 8192                    # 512 chunks, total ~4.2M
+    bins = jnp.asarray(np.zeros((n, 1), np.uint8))
+    g = np.full(n, 1.0001, np.float32)
+    gh = jnp.asarray(np.stack([g, g, np.ones(n, np.float32)], 1))
+    exact = float(np.sum(g.astype(np.float64)))
+    h32 = np.asarray(_histogram_scan(bins, gh, 512, False))[0, 0]
+    hdp = np.asarray(_histogram_scan(bins, gh, 512, True))[0, 0]
+    err32 = abs(h32[0] - exact)
+    errdp = abs(hdp[0] - exact)
+    assert errdp < err32 / 10, (err32, errdp)
+    assert errdp / exact < 1e-5, errdp
