@@ -22,9 +22,15 @@ from .utils.log import LightGBMError, log_info, log_warning
 __all__ = ["Dataset", "Booster", "LightGBMError"]
 
 
+def _is_sparse(data) -> bool:
+    return hasattr(data, "tocsr") and hasattr(data, "nnz")
+
+
 def _to_2d_float(data, feature_name=None):
     """Coerce user input (ndarray / pandas / scipy sparse / list) to a dense
-    float64 matrix + feature names."""
+    float64 matrix + feature names.  (Sparse inputs in the Dataset
+    construction path never reach this - they bin CSR-natively; this
+    densify only serves prediction batches and is chunked by callers.)"""
     names = None
     if hasattr(data, "toarray"):          # scipy sparse
         data = data.toarray()
@@ -100,12 +106,12 @@ class Dataset:
             arr, label, names = load_text_file(self.data, cfg)
             if self.label is None and label is not None:
                 self.label = label
+        elif _is_sparse(self.data):
+            arr, names = None, (list(self.feature_name)
+                                if self.feature_name not in (None, "auto")
+                                else None)
         else:
             arr, names = _to_2d_float(self.data, self.feature_name)
-        cats = _resolve_categorical(
-            self.categorical_feature
-            if self.categorical_feature != "auto" else None,
-            names, arr.shape[1])
         ref_handle = (self.reference._handle if self.reference is not None
                       else None)
         if self.used_indices is not None and self.reference is not None:
@@ -113,10 +119,28 @@ class Dataset:
                 np.asarray(self.used_indices, np.int64))
             self._set_metadata(self._handle, subset=True)
             return self
-        self._handle = BinnedDataset.construct_from_matrix(
-            arr, cfg, cats, feature_names=names, reference=ref_handle)
-        self._set_metadata(self._handle)
-        self.raw = arr if not self.free_raw_data else None
+        if arr is None:
+            # CSR-native path: bin straight from the sparse structure
+            # (memory ~ nnz), never densifying
+            csr = self.data.tocsr()
+            cats = _resolve_categorical(
+                self.categorical_feature
+                if self.categorical_feature != "auto" else None,
+                names, csr.shape[1])
+            self._handle = BinnedDataset.construct_from_csr(
+                csr.indptr, csr.indices, csr.data, csr.shape[1], cfg, cats,
+                feature_names=names, reference=ref_handle)
+            self._set_metadata(self._handle)
+            self.raw = csr if not self.free_raw_data else None
+        else:
+            cats = _resolve_categorical(
+                self.categorical_feature
+                if self.categorical_feature != "auto" else None,
+                names, arr.shape[1])
+            self._handle = BinnedDataset.construct_from_matrix(
+                arr, cfg, cats, feature_names=names, reference=ref_handle)
+            self._set_metadata(self._handle)
+            self.raw = arr if not self.free_raw_data else None
         if self.free_raw_data and not isinstance(self.data, str):
             self.data = None
         return self
@@ -358,6 +382,17 @@ class Booster:
         if isinstance(data, Dataset):
             raise TypeError("Cannot use Dataset instance for prediction, "
                             "please use raw data instead")
+        if _is_sparse(data) and not pred_leaf and not pred_contrib:
+            # sparse inputs predict in row chunks so peak dense memory is
+            # bounded regardless of the matrix height (the fork harness
+            # predicts 20M-request windows from CSR, src/test.cpp:211-241)
+            csr = data.tocsr()
+            chunk = max(1, 1 << 16)
+            outs = [self._gbdt.predict(csr[i:i + chunk].toarray(),
+                                       num_iteration=num_iteration,
+                                       raw_score=raw_score)
+                    for i in range(0, csr.shape[0], chunk)]
+            return np.concatenate(outs, axis=0)
         arr, _ = _to_2d_float(data)
         return self._gbdt.predict(arr, num_iteration=num_iteration,
                                   raw_score=raw_score, pred_leaf=pred_leaf,
