@@ -97,6 +97,14 @@ class Dataset:
             self.reference.construct()
             params = {**self.reference.params, **params}
         cfg = Config(params)
+        if self.used_indices is not None and self.reference is not None:
+            # subset construction (cv folds, bagging subsets) never touches
+            # raw data: it slices the parent's binned matrix
+            self.reference.construct()
+            self._handle = self.reference._handle.copy_subset(
+                np.asarray(self.used_indices, np.int64))
+            self._set_metadata(self._handle, subset=True)
+            return self
         if isinstance(self.data, str):
             if BinnedDataset.is_binary_file(self.data):
                 self._handle = BinnedDataset.load_binary(self.data)
@@ -114,11 +122,6 @@ class Dataset:
             arr, names = _to_2d_float(self.data, self.feature_name)
         ref_handle = (self.reference._handle if self.reference is not None
                       else None)
-        if self.used_indices is not None and self.reference is not None:
-            self._handle = self.reference._handle.copy_subset(
-                np.asarray(self.used_indices, np.int64))
-            self._set_metadata(self._handle, subset=True)
-            return self
         if arr is None:
             # CSR-native path: bin straight from the sparse structure
             # (memory ~ nnz), never densifying
