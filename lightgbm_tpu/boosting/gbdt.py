@@ -69,7 +69,12 @@ class _PendingTree:
         nl = int(np.asarray(self.nl))
         tree = Tree(config.num_leaves)
         if nl <= 1:
-            tree.leaf_value[0] = float(np.asarray(self.root_value))
+            # stump: the grower applied NOTHING to the training scores
+            # (grow.py zeroes the update when nl<=1), so the materialized
+            # tree must carry 0 too — only the boost_from_average bias
+            # (added below) reaches the model, matching the host path at
+            # GBDT.train_one_iter's stump branch
+            tree.leaf_value[0] = 0.0
         else:
             rec_i = np.asarray(self.rec_i)
             rec_f = np.asarray(self.rec_f)
@@ -112,6 +117,9 @@ class GBDT:
         self._bag_rng = np.random.RandomState(config.bagging_seed & 0x7FFFFFFF)
         self.class_need_train: List[bool] = [True]
         self.best_iteration = -1
+        self._grower = None
+        self._device_stop = False
+        self._nl_queue: List = []   # in-flight num_leaves handles (lagged)
 
     # ------------------------------------------------------------------
     def init_train(self, train_set: BinnedDataset, objective=None):
@@ -172,9 +180,6 @@ class GBDT:
             and not self.need_bagging)
         # on-device wave grower (one dispatch per iteration, no per-split
         # host sync) when the configuration is eligible
-        self._grower = None
-        self._device_stop = False
-        self._iters_since_check = 0
         mode = str(getattr(cfg, "device_growth", "off")).lower()
         want = mode == "on" or (mode == "auto"
                                 and jax.default_backend() == "tpu")
@@ -340,33 +345,47 @@ class GBDT:
             rec_i, rec_f, nl, root_val,
             self.shrinkage_rate * self._tree_multiplier(), init_score))
         self.iter += 1
-        # stump check: one tiny fetch every 32 iterations detects the
-        # "no more splittable leaves" stop condition without a per-iter
-        # round trip (the reference checks every iteration, gbdt.cpp:412)
-        self._iters_since_check += 1
-        if self._iters_since_check >= 32:
-            self._iters_since_check = 0
-            if int(np.asarray(nl)) <= 1:
+        # stump check: inspect num_leaves with a 4-iteration lag — the
+        # handle's async copy has long landed by then (each iteration is
+        # hundreds of ms of device work), so this never blocks the host
+        # and never stalls the dispatch pipeline, yet training stops at
+        # most 4 wasted dispatches after a stall (the reference checks
+        # every iteration, gbdt.cpp:412)
+        self._nl_queue.append(nl)
+        if len(self._nl_queue) > 4:
+            old = self._nl_queue.pop(0)
+            if int(np.asarray(old)) <= 1:
                 self._trim_device_stumps()
                 return True
         return False
 
     def _trim_device_stumps(self):
         """Remove trailing stump iterations (the device path keeps
-        dispatching until the periodic check notices training stalled)."""
-        self._flush_pending()
-        while self.models and self.models[-1].num_leaves <= 1:
-            del self.models[-1]
-            self.iter -= 1
+        dispatching until the lagged check notices training stalled).
+        A first-iteration stump (carrying the boost_from_average bias)
+        is kept, matching the host path's stump branch."""
         self._device_stop = True
+        self._nl_queue.clear()
+        self._flush_pending()
         log_warning("Stopped training because there are no more leaves "
                     "that meet the split requirements")
 
     def _flush_pending(self):
-        """Materialize all device-grown trees into host ``Tree`` objects."""
+        """Materialize all device-grown trees into host ``Tree`` objects,
+        then drop trailing stumps: on the device path (no bagging/GOSS) a
+        stump means the gradients are a fixed point, so every later
+        dispatch is a deterministic repeat — trimming here (not just at
+        the lagged stall check) keeps predict()/save consistent with the
+        training scores no matter when training stopped."""
         for i, m in enumerate(self.models):
             if isinstance(m, _PendingTree):
                 self.models[i] = m.materialize(self.train_set, self.config)
+        if self._grower is not None:
+            while (len(self.models) > self.num_model
+                   and self.models[-1].num_leaves <= 1):
+                del self.models[-1]
+                self.iter -= 1
+                self._device_stop = True
 
     def _catch_up_valid_scores(self):
         """Apply not-yet-applied models to every valid set's score (the
@@ -385,6 +404,11 @@ class GBDT:
                     v.score = v.score.at[idx % self.num_model].set(
                         add_tree_score(v.score[idx % self.num_model],
                                        v.binned_d, dt, 1.0))
+                elif abs(float(tree.leaf_value[0])) > K_EPSILON:
+                    # stump carrying the boost_from_average bias: apply
+                    # the constant (a 1-leaf traversal would do the same)
+                    v.score = v.score.at[idx % self.num_model].add(
+                        float(tree.leaf_value[0]))
                 v.applied_models = idx + 1
 
     def _adjust_gradients(self, grad, hess):
@@ -460,21 +484,35 @@ class GBDT:
         return len(self.models) // max(self.num_model, 1)
 
     def rollback_one_iter(self):
-        """Remove the last iteration's trees and scores (gbdt.cpp:414-430)."""
+        """Remove the last iteration's trees and scores (gbdt.cpp:414-430).
+
+        Valid-set scores on the device path lag behind the model list
+        (they are caught up lazily at eval time), so a popped tree is
+        only subtracted from a valid set that actually received it, and
+        ``applied_models`` is clamped so the replacement tree trained at
+        the same index is re-applied at the next catch-up."""
         if not self.models:
             return
         self._flush_pending()
+        base = len(self.models) - self.num_model
         for k in range(self.num_model):
-            tree = self.models[-self.num_model + k]
+            tree = self.models[base + k]
             if tree.num_leaves > 1:
                 dt = device_tree(tree, self.train_set, self.config.num_leaves)
                 self.train_score = self.train_score.at[k].set(
                     add_tree_score(self.train_score[k], self.learner.traverse_binned,
                                    dt, -1.0))
                 for v in self.valid_sets:
-                    v.score = v.score.at[k].set(
-                        add_tree_score(v.score[k], v.binned_d, dt, -1.0))
+                    # host path applies trees to valid scores eagerly in
+                    # update_score (without touching applied_models), so
+                    # the lag guard only applies on the device path
+                    if (self._grower is None
+                            or v.applied_models > base + k):
+                        v.score = v.score.at[k].set(
+                            add_tree_score(v.score[k], v.binned_d, dt, -1.0))
         del self.models[-self.num_model:]
+        for v in self.valid_sets:
+            v.applied_models = min(v.applied_models, len(self.models))
         self.iter -= 1
 
     # ------------------------------------------------------------------

@@ -98,24 +98,35 @@ def _histogram_scan(bins: jnp.ndarray, gh: jnp.ndarray,
 
     rows = bins.shape[0]
     sub = 512
-    n_sub = max(rows // sub, 1)
-    if rows % sub:                       # odd tail: single compensated step
-        n_sub, sub = 1, rows
-    bins_c = bins.reshape(n_sub, sub, g)
-    gh_c = gh.reshape(n_sub, sub, 3)
+    n_sub = rows // sub
+    tail = rows - n_sub * sub
 
-    def body_kahan(carry, xs):
+    def kahan_step(carry, h):
         acc, comp = carry
-        b, w = xs
-        h = _chunk_histogram(b, w)
         y = h - comp
         t = acc + y
         comp = (t - acc) - y
-        return (t, comp), None
+        return t, comp
 
     z = jnp.zeros((g, 256, 3), jnp.float32)
-    (acc, _), _ = jax.lax.scan(body_kahan, (z, z), (bins_c, gh_c))
-    return acc
+    carry = (z, z)
+    if n_sub:
+        bins_c = bins[:n_sub * sub].reshape(n_sub, sub, g)
+        gh_c = gh[:n_sub * sub].reshape(n_sub, sub, 3)
+
+        def body_kahan(c, xs):
+            b, w = xs
+            return kahan_step(c, _chunk_histogram(b, w)), None
+
+        carry, _ = jax.lax.scan(body_kahan, carry, (bins_c, gh_c))
+    if tail:
+        # odd tail: one EXTRA compensated step (collapsing the whole
+        # window to a single uncompensated chunk would silently drop the
+        # promised double-precision-equivalent behaviour for windows not
+        # divisible by the granule)
+        carry = kahan_step(carry, _chunk_histogram(bins[n_sub * sub:],
+                                                   gh[n_sub * sub:]))
+    return carry[0]
 
 
 @functools.partial(jax.jit, donate_argnums=())

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Calibrate timing semantics on the axon-tunnel TPU backend.
+
+block_until_ready vs device_get: a known-FLOP matmul chain tells us which
+one reflects real device execution time.
+"""
+import json
+import os
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/lgbm_tpu_xla"))
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bench(name, fn, *args, flops=None, reps=3):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    # block_until_ready timing
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t_block = (time.perf_counter() - t0) / reps * 1e3
+    # forced scalar fetch timing
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+        s = float(np.asarray(jnp.sum(out.astype(jnp.float32))
+                             if out.dtype != jnp.float32 else jnp.sum(out)))
+    t_fetch = (time.perf_counter() - t0) / reps * 1e3
+    rec = {"case": name, "ms_block": round(t_block, 3),
+           "ms_fetch": round(t_fetch, 3)}
+    if flops:
+        rec["tflops_block"] = round(flops / (t_block / 1e3) / 1e12, 1)
+        rec["tflops_fetch"] = round(flops / (t_fetch / 1e3) / 1e12, 1)
+    print(json.dumps(rec), flush=True)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    for m in (4096, 8192):
+        a = jnp.asarray(rng.standard_normal((m, m)).astype(np.float32)
+                        ).astype(jnp.bfloat16)
+        b = jnp.asarray(rng.standard_normal((m, m)).astype(np.float32)
+                        ).astype(jnp.bfloat16)
+
+        @jax.jit
+        def chain(a, b):
+            # 8 dependent matmuls -> 8 * 2*m^3 flops, can't be elided
+            x = a
+            for _ in range(8):
+                x = jnp.dot(x, b, preferred_element_type=jnp.float32
+                            ).astype(jnp.bfloat16)
+                x = x / jnp.max(jnp.abs(x))
+            return jnp.sum(x.astype(jnp.float32))
+
+        bench(f"chain8_matmul_{m}", chain, a, b, flops=8 * 2 * m ** 3)
+
+    # HBM bandwidth probe: big copy-add
+    x = jnp.asarray(rng.standard_normal(2 ** 28).astype(np.float32))  # 1GB
+
+    @jax.jit
+    def sum_all(x):
+        return jnp.sum(x)
+
+    bench("sum_1GB", sum_all, x)
+
+
+if __name__ == "__main__":
+    main()

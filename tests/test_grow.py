@@ -112,6 +112,46 @@ def test_device_stop_on_unsplittable():
     assert all(t.num_leaves <= 1 for t in bst.models) or not bst.models
 
 
+def test_device_stall_short_run_predict_consistent(reg_data):
+    """ADVICE r3 (high): a run that stalls within the first few
+    iterations must not keep stump trees carrying the unshrunk root
+    output — predict() has to agree with the host path and with the
+    (unchanged-after-bias) training scores."""
+    x, y = reg_data
+    y = y + 20.0       # nonzero mean: boost_from_average bias matters
+    params = {"objective": "regression", "num_leaves": 15,
+              "learning_rate": 0.1, "min_gain_to_split": 1e9}
+    bh = _make(params, x, y, False)
+    bd = _make(params, x, y, True)
+    for _ in range(10):
+        if bh.train_one_iter():
+            break
+    for _ in range(10):
+        if bd.train_one_iter():
+            break
+    ph = bh.predict(x[:50])
+    pd = bd.predict(x[:50])
+    np.testing.assert_allclose(pd, ph, atol=1e-6)
+    # prediction must equal the training score (the bias only)
+    ts = np.asarray(bd.train_score)[0][:50]
+    np.testing.assert_allclose(pd, ts, atol=1e-6)
+    # valid catch-up must deliver the bias too (not drop stump trees)
+    bd2 = _make(params, x, y, True)
+    cfg = Config({**params, "device_growth": "off"})
+    vds = BinnedDataset.construct_from_matrix(
+        x[:200], cfg, reference=bd2.train_set)
+    from lightgbm_tpu.data.dataset import Metadata
+    vds.metadata = Metadata(200)
+    vds.metadata.set_label(y[:200])
+    bd2.add_valid(vds, "v")
+    for _ in range(6):
+        if bd2.train_one_iter():
+            break
+    res = dict((f"{d}:{n}", v) for d, n, v, _ in bd2.eval_valid())
+    direct = float(np.mean((bd2.predict(x[:200]) - y[:200]) ** 2))
+    assert res["v:l2"] == pytest.approx(direct, rel=1e-5)
+
+
 def test_device_valid_eval_catches_up(reg_data):
     """The device path defers valid-score updates to evaluation time; the
     caught-up score must equal predicting the valid rows directly."""
@@ -131,6 +171,39 @@ def test_device_valid_eval_catches_up(reg_data):
         bd.train_one_iter()
     res = dict((f"{d}:{n}", v) for d, n, v, _ in bd.eval_valid())
     direct = float(np.mean((bd.predict(xt) - yt) ** 2))
+    assert res["v:l2"] == pytest.approx(direct, rel=1e-5)
+
+
+@pytest.mark.parametrize("device", [False, True])
+def test_rollback_valid_scores_consistent(reg_data, device):
+    """ADVICE r3 (medium): rollback_one_iter must leave every valid
+    set's score equal to predicting its rows with the shortened model,
+    on both the eager (host) and deferred (device) valid-update paths —
+    including a rollback that straddles a mid-training catch-up."""
+    x, y = reg_data
+    params = {"objective": "regression", "num_leaves": 15,
+              "learning_rate": 0.1}
+    bst = _make(params, x, y, device)
+    cfg = Config({**params, "device_growth": "off"})
+    vds = BinnedDataset.construct_from_matrix(x[:200], cfg,
+                                              reference=bst.train_set)
+    from lightgbm_tpu.data.dataset import Metadata
+    vds.metadata = Metadata(200)
+    vds.metadata.set_label(y[:200])
+    bst.add_valid(vds, "v")
+    for _ in range(4):
+        bst.train_one_iter()
+    bst.eval_valid()            # device path: catch up part-way
+    for _ in range(3):
+        bst.train_one_iter()
+    bst.rollback_one_iter()
+    res = dict((f"{d}:{n}", v) for d, n, v, _ in bst.eval_valid())
+    direct = float(np.mean((bst.predict(x[:200]) - y[:200]) ** 2))
+    assert res["v:l2"] == pytest.approx(direct, rel=1e-5)
+    # rollback + retrain: the replacement tree must reach valid scores
+    bst.train_one_iter()
+    res = dict((f"{d}:{n}", v) for d, n, v, _ in bst.eval_valid())
+    direct = float(np.mean((bst.predict(x[:200]) - y[:200]) ** 2))
     assert res["v:l2"] == pytest.approx(direct, rel=1e-5)
 
 

@@ -130,3 +130,41 @@ def test_gpu_use_dp_accumulation():
     errdp = abs(hdp[0] - exact)
     assert errdp < err32 / 10, (err32, errdp)
     assert errdp / exact < 1e-5, errdp
+
+
+def test_gpu_use_dp_odd_tail_still_compensated():
+    """A window NOT divisible by the 512-row granule must still get the
+    compensated accumulation (the tail is an extra Kahan step, not a
+    collapse to one uncompensated chunk)."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.histogram import _histogram_scan
+    n = 512 * 4096 + 137              # odd tail
+    bins = jnp.asarray(np.zeros((n, 1), np.uint8))
+    g = np.full(n, 1.0001, np.float32)
+    gh = jnp.asarray(np.stack([g, g, np.ones(n, np.float32)], 1))
+    exact = float(np.sum(g.astype(np.float64)))
+    hdp = np.asarray(_histogram_scan(bins, gh, 1, True))[0, 0]
+    assert abs(hdp[0] - exact) / exact < 1e-5
+    assert hdp[2] == n
+
+
+def test_greedy_find_bin_vectorized_matches_scalar_oracle():
+    """The vectorized _greedy_find_bin must be bit-identical to the
+    reference-shaped scalar oracle over random inputs (the docstring's
+    claimed regression guard, bin.cpp:74-150 semantics)."""
+    from lightgbm_tpu.data.binning import (_greedy_find_bin,
+                                           _greedy_find_bin_scalar)
+    rng = np.random.default_rng(20260730)
+    for case in range(400):
+        num_distinct = int(rng.integers(1, 400))
+        vals = np.unique(rng.normal(0, 10, num_distinct).round(2))
+        # skewed counts so big-bin handling paths are exercised
+        counts = rng.integers(1, 50, len(vals)).astype(np.int64)
+        if case % 3 == 0:
+            counts[rng.integers(0, len(vals))] += int(rng.integers(100, 2000))
+        total = int(counts.sum())
+        max_bin = int(rng.integers(2, 70))
+        mdib = int(rng.choice([0, 1, 3, 10]))
+        got = _greedy_find_bin(vals, counts, max_bin, total, mdib)
+        want = _greedy_find_bin_scalar(vals, counts, max_bin, total, mdib)
+        assert got == want, (case, max_bin, mdib, got, want)
