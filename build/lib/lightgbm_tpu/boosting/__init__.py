@@ -1,0 +1,22 @@
+"""Boosting layer (reference ``src/boosting/``).
+
+Factory mirrors ``Boosting::CreateBoosting`` (boosting.cpp:30-64).
+"""
+
+from .gbdt import GBDT
+from .dart import DART
+from .goss import GOSS
+from .rf import RF
+
+
+def create_boosting(config):
+    name = config.boosting
+    if name == "gbdt":
+        return GBDT(config)
+    if name == "dart":
+        return DART(config)
+    if name == "goss":
+        return GOSS(config)
+    if name == "rf":
+        return RF(config)
+    raise ValueError(f"unknown boosting type: {name}")

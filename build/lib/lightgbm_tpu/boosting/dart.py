@@ -1,0 +1,123 @@
+"""DART boosting (reference ``src/boosting/dart.hpp``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops.traverse import add_tree_score, device_tree
+from .gbdt import GBDT
+
+
+class DART(GBDT):
+    """Dropout trees: per iteration drop a random subset of prior trees from
+    the training score, train on the modified residual, then run the
+    three-step normalization (dart.hpp:86-186)."""
+
+    def init_train(self, train_set, objective=None):
+        super().init_train(train_set, objective)
+        self._drop_rng = np.random.RandomState(
+            self.config.drop_seed & 0x7FFFFFFF)
+        self.tree_weight = []
+        self.sum_weight = 0.0
+        self.drop_index = []
+        self.is_constant_hessian = False
+
+    # -- score helpers -------------------------------------------------
+    def _add_tree_everywhere(self, tree, k, train=True, valid=True):
+        dt = device_tree(tree, self.train_set, self.config.num_leaves)
+        if train:
+            self.train_score = self.train_score.at[k].set(
+                add_tree_score(self.train_score[k], self.learner.binned,
+                               dt, 1.0))
+        if valid:
+            for v in self.valid_sets:
+                v.score = v.score.at[k].set(
+                    add_tree_score(v.score[k], v.binned_d, dt, 1.0))
+
+    # ------------------------------------------------------------------
+    def _dropping_trees(self):
+        cfg = self.config
+        self.drop_index = []
+        is_skip = self._drop_rng.rand() < cfg.skip_drop
+        if not is_skip and self.iter > 0:
+            drop_rate = cfg.drop_rate
+            if not cfg.uniform_drop:
+                inv_avg = len(self.tree_weight) / max(self.sum_weight, 1e-35)
+                if cfg.max_drop > 0:
+                    drop_rate = min(drop_rate, cfg.max_drop * inv_avg
+                                    / max(self.sum_weight, 1e-35))
+                for i in range(self.iter):
+                    if self._drop_rng.rand() < (drop_rate
+                                                * self.tree_weight[i]
+                                                * inv_avg):
+                        self.drop_index.append(self.num_init_iteration + i)
+                        if (cfg.max_drop > 0
+                                and len(self.drop_index) >= cfg.max_drop):
+                            break
+            else:
+                if cfg.max_drop > 0:
+                    drop_rate = min(drop_rate, cfg.max_drop / self.iter)
+                for i in range(self.iter):
+                    if self._drop_rng.rand() < drop_rate:
+                        self.drop_index.append(self.num_init_iteration + i)
+                        if (cfg.max_drop > 0
+                                and len(self.drop_index) >= cfg.max_drop):
+                            break
+        # subtract dropped trees from the training score
+        for i in self.drop_index:
+            for k in range(self.num_model):
+                tree = self.models[i * self.num_model + k]
+                tree.apply_shrinkage(-1.0)
+                self._add_tree_everywhere(tree, k, train=True, valid=False)
+        k_drop = len(self.drop_index)
+        if not cfg.xgboost_dart_mode:
+            self.shrinkage_rate = cfg.learning_rate / (1.0 + k_drop)
+        else:
+            self.shrinkage_rate = (cfg.learning_rate if k_drop == 0
+                                   else cfg.learning_rate
+                                   / (cfg.learning_rate + k_drop))
+
+    def _normalize(self):
+        cfg = self.config
+        k = float(len(self.drop_index))
+        for i in self.drop_index:
+            for cid in range(self.num_model):
+                tree = self.models[i * self.num_model + cid]
+                if not cfg.xgboost_dart_mode:
+                    tree.apply_shrinkage(1.0 / (k + 1.0))
+                    self._add_tree_everywhere(tree, cid, train=False,
+                                              valid=True)
+                    tree.apply_shrinkage(-k)
+                    self._add_tree_everywhere(tree, cid, train=True,
+                                              valid=False)
+                else:
+                    tree.apply_shrinkage(self.shrinkage_rate)
+                    self._add_tree_everywhere(tree, cid, train=False,
+                                              valid=True)
+                    tree.apply_shrinkage(-k / cfg.learning_rate)
+                    self._add_tree_everywhere(tree, cid, train=True,
+                                              valid=False)
+            if not cfg.uniform_drop:
+                if not cfg.xgboost_dart_mode:
+                    self.sum_weight -= self.tree_weight[
+                        i - self.num_init_iteration] * (1.0 / (k + 1.0))
+                    self.tree_weight[i - self.num_init_iteration] *= \
+                        k / (k + 1.0)
+                else:
+                    self.sum_weight -= self.tree_weight[
+                        i - self.num_init_iteration] \
+                        * (1.0 / (k + cfg.learning_rate))
+                    self.tree_weight[i - self.num_init_iteration] *= \
+                        k / (k + cfg.learning_rate)
+
+    # ------------------------------------------------------------------
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        self._dropping_trees()
+        ret = super().train_one_iter(gradients, hessians)
+        if ret:
+            return ret
+        self._normalize()
+        if not self.config.uniform_drop:
+            self.tree_weight.append(self.shrinkage_rate)
+            self.sum_weight += self.shrinkage_rate
+        return False

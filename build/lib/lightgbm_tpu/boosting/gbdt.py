@@ -1,0 +1,753 @@
+"""GBDT: the boosting iteration loop, bagging, scores, model ser/de.
+
+Re-design of the reference ``GBDT`` (``src/boosting/gbdt.cpp``,
+``gbdt_model_text.cpp``) for the TPU runtime: scores live on device as
+(num_model, N) float32; gradients come from jitted objectives; the tree
+learner owns the device partition; validation scores update through the
+on-device tree traversal.  Model text format is the reference's "v2".
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..data.dataset import BinnedDataset
+from ..metrics import create_metrics, create_metric
+from ..objectives import create_objective
+from ..ops.grow import (DeviceGrower, REC_F_FIELDS, REC_I_FIELDS,
+                        device_growth_eligible)
+from ..ops.histogram import bucket_size
+from ..ops.traverse import DeviceTree, add_tree_score, device_tree
+from ..tree.learner import SerialTreeLearner
+from ..tree.tree import Tree
+from ..utils.log import LightGBMError, log_info, log_warning
+from ..parallel import create_tree_learner
+
+K_EPSILON = 1e-15
+MODEL_VERSION = "v2"
+
+
+class _ValidSet:
+    __slots__ = ("dataset", "binned_d", "score", "metrics", "name",
+                 "applied_models")
+
+    def __init__(self, dataset, binned_d, score, metrics, name):
+        self.dataset = dataset
+        self.binned_d = binned_d
+        self.score = score
+        self.metrics = metrics
+        self.name = name
+        self.applied_models = 0     # models already added to `score`
+
+
+class _PendingTree:
+    """Device-side split records of a tree grown by the DeviceGrower;
+    replayed into a host ``Tree`` lazily (``GBDT._flush_pending``)."""
+
+    __slots__ = ("rec_i", "rec_f", "nl", "root_value", "shrinkage", "bias")
+
+    def __init__(self, rec_i, rec_f, nl, root_value, shrinkage, bias):
+        self.rec_i = rec_i
+        self.rec_f = rec_f
+        self.nl = nl
+        self.root_value = root_value
+        self.shrinkage = shrinkage
+        self.bias = bias
+        for arr in (rec_i, rec_f, nl, root_value):
+            try:
+                arr.copy_to_host_async()
+            except AttributeError:
+                pass
+
+    def materialize(self, dataset, config) -> Tree:
+        nl = int(np.asarray(self.nl))
+        tree = Tree(config.num_leaves)
+        if nl <= 1:
+            # stump: the grower applied NOTHING to the training scores
+            # (grow.py zeroes the update when nl<=1), so the materialized
+            # tree must carry 0 too — only the boost_from_average bias
+            # (added below) reaches the model, matching the host path at
+            # GBDT.train_one_iter's stump branch
+            tree.leaf_value[0] = 0.0
+        else:
+            rec_i = np.asarray(self.rec_i)
+            rec_f = np.asarray(self.rec_f)
+            for s in range(nl - 1):
+                leaf, right, f, thr, dl = (int(v) for v in rec_i[s])
+                (gain, lg, lh, lc, rg, rh, rc, lout, rout) = (
+                    float(v) for v in rec_f[s])
+                real_f = dataset.used_features[f]
+                mapper = dataset.bin_mappers[real_f]
+                missing = int(dataset.f_missing_type[f])
+                tree.split(leaf, f, real_f, thr,
+                           mapper.bin_to_value(thr), lout, rout, int(lc),
+                           int(rc), gain, missing, bool(dl))
+            tree.apply_shrinkage(self.shrinkage)
+        if abs(self.bias) > K_EPSILON:
+            tree.add_bias(self.bias)
+        return tree
+
+
+class GBDT:
+    """Gradient Boosting Decision Tree driver."""
+
+    def __init__(self, config: Config):
+        self.config = config
+        self.models: List[Tree] = []
+        self.iter = 0
+        self.train_set: Optional[BinnedDataset] = None
+        self.objective = None
+        self.num_model = 1
+        self.shrinkage_rate = config.learning_rate
+        self.valid_sets: List[_ValidSet] = []
+        self.train_metrics = []
+        self.num_init_iteration = 0
+        self.average_output = False
+        self.loaded_objective_str = ""
+        self.loaded_parameters = ""
+        self.max_feature_idx = 0
+        self.feature_names: List[str] = []
+        self.feature_infos: List[str] = []
+        self._bag_rng = np.random.RandomState(config.bagging_seed & 0x7FFFFFFF)
+        self.class_need_train: List[bool] = [True]
+        self.best_iteration = -1
+        self._grower = None
+        self._device_stop = False
+        self._nl_queue: List = []   # in-flight num_leaves handles (lagged)
+
+    # ------------------------------------------------------------------
+    def init_train(self, train_set: BinnedDataset, objective=None):
+        cfg = self.config
+        self.train_set = train_set
+        self.objective = objective if objective is not None \
+            else create_objective(cfg)
+        if self.objective is not None:
+            self.objective.init(train_set.metadata, train_set.num_data)
+            self.num_model = self.objective.num_model_per_iteration
+            self.class_need_train = [
+                self.objective.class_need_train(k)
+                for k in range(self.num_model)]
+        else:
+            self.num_model = max(int(cfg.num_class), 1)
+            self.class_need_train = [True] * self.num_model
+        self.learner = create_tree_learner(cfg, train_set)
+        if getattr(cfg, "forcedsplits_filename", ""):
+            import json
+            with open(cfg.forcedsplits_filename) as fh:
+                self.learner.forced_splits = json.load(fh)
+            log_info(f"Loaded forced splits from "
+                     f"{cfg.forcedsplits_filename}")
+        n = train_set.num_data
+        self.num_data = n
+        self.train_score = jnp.zeros((self.num_model, n), jnp.float32)
+        md = train_set.metadata
+        self.has_init_score = md.init_score is not None
+        if self.has_init_score:
+            # class-major layout [k*num_data + i], like the reference's
+            # Metadata (metadata.cpp checks the exact size and Fatal()s on
+            # mismatch; a silently clamped (1, N) here trained wrong
+            # multiclass models)
+            init = np.asarray(md.init_score, np.float64).reshape(-1)
+            if len(init) != n * self.num_model:
+                raise LightGBMError(
+                    f"Initial score size doesn't match data size: got "
+                    f"{len(init)}, expected num_data * num_model = "
+                    f"{n} * {self.num_model}")
+            self.train_score = jnp.asarray(
+                init.reshape(self.num_model, n), jnp.float32)
+        self.train_metrics = create_metrics(cfg)
+        for m in self.train_metrics:
+            m.init(md, n)
+        self.feature_names = list(train_set.feature_names)
+        self.max_feature_idx = train_set.num_total_features - 1
+        self.feature_infos = [
+            m.feature_info_str() if m is not None else "none"
+            for m in train_set.bin_mappers]
+        # bagging state
+        self.bag_fraction = cfg.bagging_fraction
+        self.bag_freq = cfg.bagging_freq
+        self.need_bagging = self.bag_fraction < 1.0 and self.bag_freq > 0
+        self.bag_buffer = None
+        self.bag_count = n
+        self.is_constant_hessian = bool(
+            self.objective and self.objective.is_constant_hessian
+            and not self.need_bagging)
+        # on-device wave grower (one dispatch per iteration, no per-split
+        # host sync) when the configuration is eligible
+        mode = str(getattr(cfg, "device_growth", "off")).lower()
+        want = mode == "on" or (mode == "auto"
+                                and jax.default_backend() == "tpu")
+        if want and type(self) is GBDT:
+            serial = (cfg.tree_learner == "serial"
+                      or int(cfg.num_machines) <= 1)
+            if serial and device_growth_eligible(cfg, train_set,
+                                                 self.objective,
+                                                 self.num_model):
+                self._grower = DeviceGrower(train_set, cfg)
+                log_info("Using on-device tree growth (device_growth="
+                         f"{mode})")
+            elif mode == "on":
+                log_warning("device_growth=on requested but the "
+                            "configuration is not eligible (categorical/"
+                            "monotone/bagging/multiclass/renew objective); "
+                            "falling back to the host-driven learner")
+
+    def add_valid(self, valid_set: BinnedDataset, name: str):
+        if not valid_set.check_align(self.train_set):
+            raise LightGBMError(
+                "cannot add validation data, since it has different bin "
+                "mappers with training data")
+        metrics = create_metrics(self.config)
+        for m in metrics:
+            m.init(valid_set.metadata, valid_set.num_data)
+        score = jnp.zeros((self.num_model, valid_set.num_data), jnp.float32)
+        if valid_set.metadata.init_score is not None:
+            init = np.asarray(valid_set.metadata.init_score,
+                              np.float64).reshape(-1)
+            if len(init) != valid_set.num_data * self.num_model:
+                raise LightGBMError(
+                    f"Initial score size doesn't match data size: got "
+                    f"{len(init)}, expected "
+                    f"{valid_set.num_data} * {self.num_model}")
+            score = jnp.asarray(
+                init.reshape(self.num_model, valid_set.num_data),
+                jnp.float32)
+        vs = _ValidSet(valid_set, jnp.asarray(valid_set.binned), score,
+                       metrics, name)
+        # device path: models that predate this valid set are skipped in
+        # catch-up, matching the host path (which only applies new trees)
+        vs.applied_models = len(self.models)
+        self.valid_sets.append(vs)
+
+    # ------------------------------------------------------------------
+    def boost_from_average(self, class_id: int) -> float:
+        cfg = self.config
+        if (self.models or self.has_init_score or self.objective is None):
+            return 0.0
+        if cfg.boost_from_average or self.train_set.num_features == 0:
+            init_score = self.objective.boost_from_score(class_id)
+            if abs(init_score) > K_EPSILON:
+                self.train_score = self.train_score.at[class_id].add(
+                    init_score)
+                if self._grower is None:
+                    # device path: valid sets receive the bias through the
+                    # materialized first tree at catch-up time instead
+                    for v in self.valid_sets:
+                        v.score = v.score.at[class_id].add(init_score)
+                log_info(f"Start training from score {init_score:f}")
+                return init_score
+        elif self.objective.name in ("regression_l1", "quantile", "mape"):
+            log_warning(f"Disabling boost_from_average in "
+                        f"{self.objective.name} may cause the slow "
+                        f"convergence")
+        return 0.0
+
+    # ------------------------------------------------------------------
+    def bagging(self, it: int):
+        """Row bagging via a device bernoulli mask partition
+        (gbdt.cpp:161-243 semantics, binomial count).  The selection layout
+        is the learner's (serial: one permutation buffer; data-parallel:
+        per-shard buffers), so it delegates to ``learner.bagging_state``."""
+        if not self.need_bagging or it % self.bag_freq != 0:
+            return
+        seed = (self.config.bagging_seed + it) & 0x7FFFFFFF
+        self.bag_buffer, self.bag_count = self.learner.bagging_state(
+            seed, self.bag_fraction)
+
+    def _tree_multiplier(self) -> float:
+        return 1.0
+
+    # ------------------------------------------------------------------
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        """One boosting iteration; returns True when training should stop
+        (no splittable leaves), mirroring GBDT::TrainOneIter."""
+        if (self._grower is not None and gradients is None
+                and hessians is None):
+            return self._train_one_iter_device()
+        init_scores = [0.0] * self.num_model
+        if gradients is None or hessians is None:
+            for k in range(self.num_model):
+                init_scores[k] = self.boost_from_average(k)
+            grad, hess = self.objective.get_gradients(self.train_score)
+            if grad.ndim == 1:
+                grad, hess = grad[None, :], hess[None, :]
+        else:
+            grad = jnp.asarray(np.asarray(gradients, np.float32)
+                               ).reshape(self.num_model, -1)
+            hess = jnp.asarray(np.asarray(hessians, np.float32)
+                               ).reshape(self.num_model, -1)
+        grad, hess = self._adjust_gradients(grad, hess)
+        self.bagging(self.iter)
+        grad, hess = self._post_bagging_adjust(grad, hess)
+
+        should_continue = False
+        for k in range(self.num_model):
+            tree = Tree(2)
+            if self.class_need_train[k] and self.train_set.num_features > 0:
+                tree = self.learner.train(
+                    grad[k], hess[k],
+                    indices_buffer=self.bag_buffer,
+                    data_count=self.bag_count
+                    if self.bag_buffer is not None else None)
+            if tree.num_leaves > 1:
+                should_continue = True
+                self._renew_tree_output(tree, k)
+                tree.apply_shrinkage(self.shrinkage_rate
+                                     * self._tree_multiplier())
+                self.update_score(tree, k)
+                if abs(init_scores[k]) > K_EPSILON:
+                    tree.add_bias(init_scores[k])
+            else:
+                if len(self.models) < self.num_model:
+                    if not self.class_need_train[k]:
+                        output = (self.objective.boost_from_score(k)
+                                  if self.objective else 0.0)
+                    else:
+                        output = init_scores[k]
+                    tree = Tree(2)
+                    tree.leaf_value[0] = output
+                    if abs(output) > K_EPSILON:
+                        self.train_score = self.train_score.at[k].add(output)
+                        for v in self.valid_sets:
+                            v.score = v.score.at[k].add(output)
+            self.models.append(tree)
+
+        if not should_continue:
+            log_warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            if len(self.models) > self.num_model:
+                del self.models[-self.num_model:]
+            return True
+        self.iter += 1
+        return False
+
+    # ------------------------------------------------------------------
+    # on-device fast path: one dispatch per iteration, no per-split sync
+    def _train_one_iter_device(self) -> bool:
+        if self._device_stop:
+            return True
+        init_score = self.boost_from_average(0)
+        grad, hess = self.objective.get_gradients(self.train_score)
+        if grad.ndim > 1:
+            grad, hess = grad[0], hess[0]
+        mask = self.learner._feature_mask()
+        score, rec_i, rec_f, nl, root_val = self._grower.grow_one_iter(
+            self.train_score[0], grad, hess, mask,
+            self.shrinkage_rate * self._tree_multiplier())
+        self.train_score = score[None, :]
+        self.models.append(_PendingTree(
+            rec_i, rec_f, nl, root_val,
+            self.shrinkage_rate * self._tree_multiplier(), init_score))
+        self.iter += 1
+        # stump check: inspect num_leaves with a 4-iteration lag — the
+        # handle's async copy has long landed by then (each iteration is
+        # hundreds of ms of device work), so this never blocks the host
+        # and never stalls the dispatch pipeline, yet training stops at
+        # most 4 wasted dispatches after a stall (the reference checks
+        # every iteration, gbdt.cpp:412)
+        self._nl_queue.append(nl)
+        if len(self._nl_queue) > 4:
+            old = self._nl_queue.pop(0)
+            if int(np.asarray(old)) <= 1:
+                self._trim_device_stumps()
+                return True
+        return False
+
+    def _trim_device_stumps(self):
+        """Remove trailing stump iterations (the device path keeps
+        dispatching until the lagged check notices training stalled).
+        A first-iteration stump (carrying the boost_from_average bias)
+        is kept, matching the host path's stump branch."""
+        self._device_stop = True
+        self._nl_queue.clear()
+        self._flush_pending()
+        log_warning("Stopped training because there are no more leaves "
+                    "that meet the split requirements")
+
+    def _flush_pending(self):
+        """Materialize all device-grown trees into host ``Tree`` objects,
+        then drop trailing stumps: on the device path (no bagging/GOSS) a
+        stump means the gradients are a fixed point, so every later
+        dispatch is a deterministic repeat — trimming here (not just at
+        the lagged stall check) keeps predict()/save consistent with the
+        training scores no matter when training stopped."""
+        for i, m in enumerate(self.models):
+            if isinstance(m, _PendingTree):
+                self.models[i] = m.materialize(self.train_set, self.config)
+        if self._grower is not None:
+            while (len(self.models) > self.num_model
+                   and self.models[-1].num_leaves <= 1):
+                del self.models[-1]
+                self.iter -= 1
+                self._device_stop = True
+
+    def _catch_up_valid_scores(self):
+        """Apply not-yet-applied models to every valid set's score (the
+        device path defers valid updates to evaluation time)."""
+        if not self.valid_sets:
+            return
+        self._flush_pending()
+        total = len(self.models)
+        for v in self.valid_sets:
+            while v.applied_models < total:
+                idx = v.applied_models
+                tree = self.models[idx]
+                if tree.num_leaves > 1:
+                    dt = device_tree(tree, self.train_set,
+                                     self.config.num_leaves)
+                    v.score = v.score.at[idx % self.num_model].set(
+                        add_tree_score(v.score[idx % self.num_model],
+                                       v.binned_d, dt, 1.0))
+                elif abs(float(tree.leaf_value[0])) > K_EPSILON:
+                    # stump carrying the boost_from_average bias: apply
+                    # the constant (a 1-leaf traversal would do the same)
+                    v.score = v.score.at[idx % self.num_model].add(
+                        float(tree.leaf_value[0]))
+                v.applied_models = idx + 1
+
+    def _adjust_gradients(self, grad, hess):
+        return grad, hess
+
+    def _post_bagging_adjust(self, grad, hess):
+        return grad, hess
+
+    # ------------------------------------------------------------------
+    def _renew_tree_output(self, tree: Tree, class_id: int):
+        """Percentile leaf renewal for L1-style objectives
+        (serial_tree_learner.cpp:780-818)."""
+        obj = self.objective
+        if obj is None or not obj.is_renew_tree_output:
+            return
+        score = np.asarray(self.train_score[class_id], np.float64)
+        label = np.asarray(obj.label, np.float64)
+        leaf_rows = self.learner.leaf_indices_host()
+        if obj.name == "mape":
+            w = obj.label_weight
+        else:
+            w = obj.weights
+        for leaf, rows in leaf_rows.items():
+            if len(rows) == 0:
+                continue
+            residuals = label[rows] - score[rows]
+            lw = w[rows] if w is not None else None
+            tree.set_leaf_output(
+                leaf, obj.renew_tree_output(float(tree.leaf_value[leaf]),
+                                            residuals, lw))
+
+    def update_score(self, tree: Tree, class_id: int):
+        """Train (partition or traversal when bagging) + valid scores."""
+        if self.bag_buffer is not None and self.bag_count < self.num_data:
+            dt = device_tree(tree, self.train_set, self.config.num_leaves)
+            self.train_score = self.train_score.at[class_id].set(
+                add_tree_score(self.train_score[class_id],
+                               self.learner.traverse_binned, dt, 1.0))
+        else:
+            self.train_score = self.train_score.at[class_id].set(
+                self.learner.update_score(self.train_score[class_id], tree))
+            dt = None
+        for v in self.valid_sets:
+            if dt is None:
+                dt = device_tree(tree, self.train_set, self.config.num_leaves)
+            v.score = v.score.at[class_id].set(
+                add_tree_score(v.score[class_id], v.binned_d, dt, 1.0))
+
+    # ------------------------------------------------------------------
+    def eval_train(self) -> List[Tuple[str, str, float, bool]]:
+        out = []
+        if not self.train_metrics:
+            return out
+        score = np.asarray(self.train_score, np.float64)
+        for m in self.train_metrics:
+            for name, value in m.eval(score, self.objective):
+                out.append(("training", name, value, m.bigger_is_better))
+        return out
+
+    def eval_valid(self) -> List[Tuple[str, str, float, bool]]:
+        out = []
+        if self._grower is not None:
+            self._catch_up_valid_scores()
+        for v in self.valid_sets:
+            score = np.asarray(v.score, np.float64)
+            for m in v.metrics:
+                for name, value in m.eval(score, self.objective):
+                    out.append((v.name, name, value, m.bigger_is_better))
+        return out
+
+    # ------------------------------------------------------------------
+    def num_iterations(self) -> int:
+        return len(self.models) // max(self.num_model, 1)
+
+    def rollback_one_iter(self):
+        """Remove the last iteration's trees and scores (gbdt.cpp:414-430).
+
+        Valid-set scores on the device path lag behind the model list
+        (they are caught up lazily at eval time), so a popped tree is
+        only subtracted from a valid set that actually received it, and
+        ``applied_models`` is clamped so the replacement tree trained at
+        the same index is re-applied at the next catch-up."""
+        if not self.models:
+            return
+        self._flush_pending()
+        base = len(self.models) - self.num_model
+        for k in range(self.num_model):
+            tree = self.models[base + k]
+            if tree.num_leaves > 1:
+                dt = device_tree(tree, self.train_set, self.config.num_leaves)
+                self.train_score = self.train_score.at[k].set(
+                    add_tree_score(self.train_score[k], self.learner.traverse_binned,
+                                   dt, -1.0))
+                for v in self.valid_sets:
+                    # host path applies trees to valid scores eagerly in
+                    # update_score (without touching applied_models), so
+                    # the lag guard only applies on the device path
+                    if (self._grower is None
+                            or v.applied_models > base + k):
+                        v.score = v.score.at[k].set(
+                            add_tree_score(v.score[k], v.binned_d, dt, -1.0))
+        del self.models[-self.num_model:]
+        for v in self.valid_sets:
+            v.applied_models = min(v.applied_models, len(self.models))
+        self.iter -= 1
+
+    # ------------------------------------------------------------------
+    # prediction (raw host data)
+    def _early_stop_instance(self):
+        """Row-wise prediction early stopping
+        (src/boosting/prediction_early_stop.cpp:1-89): binary stops a row
+        once 2*|margin| exceeds the threshold, multiclass once the top-two
+        class margin does; checked every ``pred_early_stop_freq`` trees."""
+        cfg = self.config
+        if not getattr(cfg, "pred_early_stop", False):
+            return None
+        obj_name = (self.objective.name if self.objective is not None
+                    else (self.loaded_objective_str.split()[0]
+                          if self.loaded_objective_str else ""))
+        margin = float(cfg.pred_early_stop_margin)
+        freq = max(int(cfg.pred_early_stop_freq), 1)
+        if obj_name.startswith("binary") and self.num_model == 1:
+            return freq, lambda out: 2.0 * np.abs(out[0]) > margin
+        if self.num_model > 1:
+            def mc(out):
+                part = np.partition(out, self.num_model - 2, axis=0)
+                return part[-1] - part[-2] > margin
+            return freq, mc
+        log_warning("pred_early_stop is only supported for binary and "
+                    "multiclass objectives; ignoring")
+        return None
+
+    def predict_raw(self, data: np.ndarray, num_iteration: int = -1,
+                    start_iteration: int = 0) -> np.ndarray:
+        self._flush_pending()
+        data = np.ascontiguousarray(np.asarray(data, np.float64))
+        n = data.shape[0]
+        out = np.zeros((self.num_model, n), np.float64)
+        total_iter = self.num_iterations()
+        end_iter = total_iter if num_iteration <= 0 \
+            else min(start_iteration + num_iteration, total_iter)
+        early = self._early_stop_instance()
+        active = None if early is None else np.ones(n, bool)
+        for it in range(start_iteration, end_iter):
+            for k in range(self.num_model):
+                tree = self.models[it * self.num_model + k]
+                if active is None:
+                    out[k] += tree.predict(data)
+                elif active.all():
+                    out[k] += tree.predict(data)
+                else:
+                    out[k, active] += tree.predict(data[active])
+            if early is not None and (it + 1 - start_iteration) \
+                    % early[0] == 0:
+                active &= ~early[1](out)
+                if not active.any():
+                    break
+        if self.average_output and end_iter > start_iteration:
+            out /= (end_iter - start_iteration)
+        return out
+
+    def predict(self, data, num_iteration: int = -1, raw_score=False,
+                pred_leaf=False, pred_contrib=False, start_iteration=0):
+        self._flush_pending()
+        if pred_leaf:
+            data = np.ascontiguousarray(np.asarray(data, np.float64))
+            total_iter = self.num_iterations()
+            end_iter = total_iter if num_iteration <= 0 \
+                else min(num_iteration, total_iter)
+            leaves = np.zeros((data.shape[0],
+                               end_iter * self.num_model), np.int32)
+            for i in range(end_iter * self.num_model):
+                leaves[:, i] = self.models[i].predict_leaf(data)
+            return leaves
+        if pred_contrib:
+            return self._predict_contrib(data, num_iteration)
+        raw = self.predict_raw(data, num_iteration, start_iteration)
+        # averaged-output models (RF) already emit converted values
+        # (gbdt.cpp:600: convert only when !average_output_)
+        if not raw_score and not self.average_output:
+            if self.objective is not None:
+                raw = self.objective.convert_output(raw)
+            elif self.loaded_objective_str:
+                raw = _convert_by_name(self.loaded_objective_str, raw)
+        if self.num_model == 1:
+            return raw[0]
+        return raw.T   # (N, K)
+
+    def _predict_contrib(self, data, num_iteration=-1):
+        data = np.ascontiguousarray(np.asarray(data, np.float64))
+        n = data.shape[0]
+        nf = self.max_feature_idx + 1
+        total_iter = self.num_iterations()
+        end_iter = total_iter if num_iteration <= 0 \
+            else min(num_iteration, total_iter)
+        out = np.zeros((n, self.num_model, nf + 1), np.float64)
+        for it in range(end_iter):
+            for k in range(self.num_model):
+                tree = self.models[it * self.num_model + k]
+                for i in range(n):
+                    tree.predict_contrib_row(data[i], out[i, k])
+        if self.num_model == 1:
+            return out[:, 0, :]
+        return out.reshape(n, -1)
+
+    # ------------------------------------------------------------------
+    def feature_importance(self, importance_type="split",
+                           iteration: int = -1) -> np.ndarray:
+        self._flush_pending()
+        nf = self.max_feature_idx + 1
+        out = np.zeros(nf, np.float64)
+        total_iter = self.num_iterations()
+        end_iter = total_iter if iteration <= 0 else min(iteration, total_iter)
+        for tree in self.models[:end_iter * self.num_model]:
+            for node in range(tree.num_leaves - 1):
+                f = int(tree.split_feature[node])
+                if importance_type == "split":
+                    out[f] += 1
+                else:
+                    out[f] += max(tree.split_gain[node], 0.0)
+        return out
+
+    # ------------------------------------------------------------------
+    # model serialization (gbdt_model_text.cpp:243-330 format "v2")
+    def model_to_string(self, start_iteration=0, num_iteration=-1) -> str:
+        self._flush_pending()
+        lines = ["tree", f"version={MODEL_VERSION}",
+                 f"num_class={max(int(self.config.num_class), 1)}",
+                 f"num_tree_per_iteration={self.num_model}",
+                 f"label_index={int(self.config.label_column or 0) if str(self.config.label_column).isdigit() else 0}",
+                 f"max_feature_idx={self.max_feature_idx}"]
+        if self.objective is not None:
+            lines.append(f"objective={self.objective.to_string()}")
+        elif self.loaded_objective_str:
+            lines.append(f"objective={self.loaded_objective_str}")
+        if self.average_output:
+            lines.append("average_output")
+        lines.append("feature_names=" + " ".join(self.feature_names))
+        lines.append("feature_infos=" + " ".join(self.feature_infos))
+
+        total_iter = self.num_iterations()
+        start_iteration = max(0, min(start_iteration, total_iter))
+        num_used = total_iter * self.num_model
+        if num_iteration > 0:
+            num_used = min((start_iteration + num_iteration) * self.num_model,
+                           num_used)
+        start_model = start_iteration * self.num_model
+        tree_strs = []
+        for i in range(start_model, num_used):
+            tree_strs.append(f"Tree={i - start_model}\n"
+                             + self.models[i].to_string())
+        sizes = [len(s) + 1 for s in tree_strs]
+        lines.append("tree_sizes=" + " ".join(str(s) for s in sizes))
+        lines.append("")
+        body = "\n".join(lines)
+        for s in tree_strs:
+            body += s + "\n"
+        body += "end of trees\n"
+        # feature importance block
+        imps = self.feature_importance("split")
+        pairs = [(int(imps[i]), self.feature_names[i])
+                 for i in np.argsort(-imps, kind="stable") if imps[i] > 0]
+        body += "\nfeature importances:\n"
+        for cnt, name in pairs:
+            body += f"{name}={cnt}\n"
+        body += "\nparameters:\n"
+        body += self._params_string()
+        body += "\nend of parameters\n"
+        return body
+
+    def _params_string(self) -> str:
+        from ..params import PARAM_BY_NAME
+        out = []
+        for p in PARAM_BY_NAME.values():
+            v = getattr(self.config, p.name, p.default)
+            if isinstance(v, list):
+                v = ",".join(str(x) for x in v)
+            out.append(f"[{p.name}: {v}]")
+        return "\n".join(out)
+
+    def save_model_to_file(self, filename, start_iteration=0,
+                           num_iteration=-1):
+        with open(filename, "w") as fh:
+            fh.write(self.model_to_string(start_iteration, num_iteration))
+        log_info(f"Finished saving model to file {filename}")
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def load_model_from_string(cls, text: str, config=None) -> "GBDT":
+        config = config or Config({})
+        booster = cls(config)
+        header, _, rest = text.partition("Tree=")
+        kv: Dict[str, str] = {}
+        for line in header.splitlines():
+            if "=" in line:
+                k, v = line.split("=", 1)
+                kv[k.strip()] = v
+            elif line.strip() == "average_output":
+                booster.average_output = True
+        booster.num_model = int(kv.get("num_tree_per_iteration", 1))
+        booster.max_feature_idx = int(kv.get("max_feature_idx", 0))
+        booster.feature_names = kv.get("feature_names", "").split()
+        booster.feature_infos = kv.get("feature_infos", "").split()
+        booster.loaded_objective_str = kv.get("objective", "")
+        num_class = int(kv.get("num_class", 1))
+        config.num_class = num_class
+        # tree blocks
+        if rest:
+            blocks = ("Tree=" + rest).split("end of trees")[0]
+            for block in blocks.split("Tree=")[1:]:
+                booster.models.append(Tree.from_string(block))
+        booster.iter = len(booster.models) // max(booster.num_model, 1)
+        booster.num_init_iteration = booster.iter
+        # loaded parameters
+        if "\nparameters:" in text:
+            booster.loaded_parameters = (
+                text.split("\nparameters:\n", 1)[1]
+                .split("\nend of parameters", 1)[0])
+        return booster
+
+    @classmethod
+    def load_model_from_file(cls, filename, config=None) -> "GBDT":
+        with open(filename) as fh:
+            return cls.load_model_from_string(fh.read(), config)
+
+
+def _convert_by_name(objective_str: str, raw: np.ndarray) -> np.ndarray:
+    """Output transform for models loaded from file (no live objective)."""
+    name = objective_str.split()[0] if objective_str else ""
+    params = dict(p.split(":", 1) for p in objective_str.split()[1:]
+                  if ":" in p)
+    if name in ("binary", "multiclassova", "cross_entropy"):
+        sigmoid = float(params.get("sigmoid", 1.0))
+        return 1.0 / (1.0 + np.exp(-sigmoid * raw))
+    if name in ("poisson", "gamma", "tweedie"):
+        return np.exp(raw)
+    if name == "multiclass":
+        e = np.exp(raw - raw.max(axis=0, keepdims=True))
+        return e / e.sum(axis=0, keepdims=True)
+    if name == "cross_entropy_lambda":
+        return np.log1p(np.exp(raw))
+    return raw

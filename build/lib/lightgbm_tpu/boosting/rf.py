@@ -1,0 +1,90 @@
+"""Random-forest mode (reference ``src/boosting/rf.hpp``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.log import LightGBMError
+from .gbdt import GBDT
+
+
+class RF(GBDT):
+    """Random forest: fixed targets (-label / -onehot), unit hessians, no
+    shrinkage, bagging mandatory, averaged output (rf.hpp:18-207)."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.average_output = True
+
+    def init_train(self, train_set, objective=None):
+        super().init_train(train_set, objective)
+        cfg = self.config
+        if not (cfg.bagging_freq > 0 and 0.0 < cfg.bagging_fraction < 1.0):
+            raise LightGBMError("RF mode requires bagging "
+                                "(bagging_freq > 0, bagging_fraction in (0,1))")
+        self.shrinkage_rate = 1.0
+        label = np.asarray(train_set.metadata.label, np.float32)
+        n = train_set.num_data
+        if self.num_model == 1:
+            grad = -label[None, :]
+        else:
+            grad = np.zeros((self.num_model, n), np.float32)
+            grad[label.astype(np.int64), np.arange(n)] = -1.0
+        self._rf_grad = jnp.asarray(grad)
+        self._rf_hess = jnp.ones((self.num_model, n), jnp.float32)
+        self.is_constant_hessian = False
+
+    def boost_from_average(self, class_id):
+        return 0.0
+
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        if gradients is not None or hessians is not None:
+            raise LightGBMError("RF mode does not support custom objectives")
+        self.bagging(self.iter)
+        should_continue = False
+        for k in range(self.num_model):
+            from ..tree.tree import Tree
+            tree = Tree(2)
+            if self.train_set.num_features > 0:
+                tree = self.learner.train(
+                    self._rf_grad[k], self._rf_hess[k],
+                    indices_buffer=self.bag_buffer,
+                    data_count=self.bag_count
+                    if self.bag_buffer is not None else None)
+            if tree.num_leaves > 1:
+                should_continue = True
+                self._renew_tree_output(tree, k)
+                self.update_score(tree, k)   # no shrinkage; scores are sums
+            self.models.append(tree)
+        if not should_continue:
+            del self.models[-self.num_model:]
+            return True
+        self.iter += 1
+        return False
+
+    def _averaged(self, score):
+        iters = max(self.num_iterations(), 1)
+        return score / iters
+
+    # The averaged score already IS the output (e.g. a probability for
+    # binary labels), so metrics must NOT re-convert through the objective
+    # (reference rf.hpp EvalOneMetric passes nullptr).
+    def eval_train(self):
+        out = []
+        if not self.train_metrics:
+            return out
+        score = self._averaged(np.asarray(self.train_score, np.float64))
+        for m in self.train_metrics:
+            for name, value in m.eval(score, None):
+                out.append(("training", name, value, m.bigger_is_better))
+        return out
+
+    def eval_valid(self):
+        out = []
+        for v in self.valid_sets:
+            score = self._averaged(np.asarray(v.score, np.float64))
+            for m in v.metrics:
+                for name, value in m.eval(score, None):
+                    out.append((v.name, name, value, m.bigger_is_better))
+        return out

@@ -1,0 +1,181 @@
+"""CLI application: train / predict / convert_model / refit.
+
+Re-implements the reference ``Application`` lifecycle
+(``src/application/application.cpp``, ``include/LightGBM/application.h:91-103``)
+for the TPU runtime: `key=value` arguments plus a ``config=`` file, side
+files (``.weight``/``.query``/``.init``), snapshotting, and metric output
+every ``metric_freq`` iterations.  Entry: ``python -m lightgbm_tpu config=...``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Dict, List
+
+import numpy as np
+
+from .boosting import create_boosting
+from .boosting.gbdt import GBDT
+from .config import Config, parse_config_str
+from .data.dataset import BinnedDataset, Metadata
+from .data.parser import (load_init_score_file, load_query_file,
+                          load_text_file, load_weight_file)
+from .utils.log import LightGBMError, log_info, log_warning
+
+
+def parse_cli_args(argv: List[str]) -> Dict[str, str]:
+    params: Dict[str, str] = {}
+    for arg in argv:
+        if "=" not in arg:
+            continue
+        k, v = arg.split("=", 1)
+        params[k.strip()] = v.strip()
+    if "config" in params and params["config"]:
+        with open(params["config"]) as fh:
+            file_params = parse_config_str(fh.read())
+        # CLI args take precedence over config-file values
+        file_params.update(params)
+        params = file_params
+    return params
+
+
+def _load_dataset(path: str, cfg: Config, reference=None) -> BinnedDataset:
+    if BinnedDataset.is_binary_file(path):
+        return BinnedDataset.load_binary(path)
+    arr, label, names = load_text_file(path, cfg)
+    cats = _parse_categorical(cfg, arr.shape[1])
+    ds = BinnedDataset.construct_from_matrix(
+        arr, cfg, cats, feature_names=names, reference=reference)
+    ds._raw = arr
+    md = ds.metadata
+    if label is not None:
+        md.set_label(label)
+    w = load_weight_file(path + ".weight")
+    if w is not None:
+        md.set_weights(w)
+    q = load_query_file(path + ".query")
+    if q is not None:
+        md.set_query(q)
+    init = load_init_score_file(path + ".init")
+    if init is not None:
+        md.set_init_score(init.T.reshape(-1) if init.ndim > 1 else init)
+    return ds
+
+
+def _parse_categorical(cfg: Config, num_features: int) -> List[int]:
+    spec = getattr(cfg, "categorical_feature", []) or []
+    out = []
+    for c in spec:
+        c = str(c)
+        if c.startswith("name:"):
+            continue
+        try:
+            out.append(int(c))
+        except ValueError:
+            pass
+    return [c for c in out if 0 <= c < num_features]
+
+
+def run_train(cfg: Config):
+    start = time.time()
+    train_ds = _load_dataset(cfg.data, cfg)
+    log_info(f"Finished loading data in {time.time() - start:.6f} seconds")
+    booster = create_boosting(cfg)
+    booster.init_train(train_ds)
+    valid_paths = cfg.valid if isinstance(cfg.valid, list) else [cfg.valid]
+    for i, vp in enumerate(v for v in valid_paths if v):
+        vds = _load_dataset(str(vp), cfg, reference=train_ds)
+        booster.add_valid(vds, f"valid_{i + 1}")
+
+    num_iters = int(cfg.num_iterations)
+    snapshot_freq = int(getattr(cfg, "snapshot_freq", -1) or -1)
+    metric_freq = max(int(cfg.metric_freq), 1)
+    out_model = cfg.output_model or "LightGBM_model.txt"
+    start = time.time()
+    for it in range(num_iters):
+        finished = booster.train_one_iter()
+        if (it + 1) % metric_freq == 0 or it == num_iters - 1:
+            for dname, mname, value, _ in (booster.eval_train()
+                                           + booster.eval_valid()):
+                log_info(f"Iteration:{it + 1}, {dname} {mname} : {value:g}")
+        log_info(f"{time.time() - start:.6f} seconds elapsed, finished "
+                 f"iteration {it + 1}")
+        if snapshot_freq > 0 and (it + 1) % snapshot_freq == 0:
+            booster.save_model_to_file(f"{out_model}.snapshot_iter_{it + 1}")
+        if finished:
+            break
+    booster.save_model_to_file(out_model)
+    log_info("Finished training")
+
+
+def run_predict(cfg: Config):
+    model_path = cfg.input_model or "LightGBM_model.txt"
+    booster = GBDT.load_model_from_file(model_path, cfg)
+    arr, _, _ = load_text_file(cfg.data, cfg)
+    pred = booster.predict(
+        arr,
+        num_iteration=int(getattr(cfg, "num_iteration_predict", -1) or -1),
+        raw_score=bool(cfg.predict_raw_score),
+        pred_leaf=bool(cfg.predict_leaf_index),
+        pred_contrib=bool(cfg.predict_contrib))
+    out = cfg.output_result or "LightGBM_predict_result.txt"
+    pred2 = np.atleast_2d(np.asarray(pred))
+    if pred2.shape[0] == 1 and np.asarray(pred).ndim == 1:
+        pred2 = pred2.T
+    np.savetxt(out, pred2, delimiter="\t", fmt="%g")
+    log_info(f"Finished prediction, saved to {out}")
+
+
+def run_convert_model(cfg: Config):
+    model_path = cfg.input_model or "LightGBM_model.txt"
+    booster = GBDT.load_model_from_file(model_path, cfg)
+    out = cfg.convert_model or "gbdt_prediction.cpp"
+    lines = ["#include <cmath>", "#include <cstdint>", ""]
+    for i, tree in enumerate(booster.models):
+        lines.append(tree.to_if_else(i, False))
+    n = len(booster.models)
+    calls = " + ".join(f"PredictTree{i}(arr)" for i in range(n)) or "0.0"
+    lines.append("double Predict(const double* arr) {\n"
+                 f"  return {calls};\n}}\n")
+    with open(out, "w") as fh:
+        fh.write("\n".join(lines))
+    log_info(f"Finished converting model to C++ code {out}")
+
+
+def run_refit(cfg: Config):
+    model_path = cfg.input_model or "LightGBM_model.txt"
+    from .basic import Booster
+    booster = Booster(model_file=model_path, params={})
+    arr, label, _ = load_text_file(cfg.data, cfg)
+    new_booster = booster.refit(arr, label,
+                                decay_rate=float(cfg.refit_decay_rate))
+    out = cfg.output_model or "LightGBM_model.txt"
+    new_booster.save_model(out)
+    log_info("Finished refitting")
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    params = parse_cli_args(argv)
+    if not params:
+        print("usage: python -m lightgbm_tpu config=train.conf [key=value...]")
+        return 1
+    cfg = Config(params)
+    task = cfg.task
+    if task == "train":
+        run_train(cfg)
+    elif task in ("predict", "prediction", "test"):
+        run_predict(cfg)
+    elif task == "convert_model":
+        run_convert_model(cfg)
+    elif task in ("refit", "refit_tree"):
+        run_refit(cfg)
+    else:
+        raise LightGBMError(f"unknown task: {task}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

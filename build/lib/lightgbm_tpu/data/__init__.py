@@ -1,0 +1,4 @@
+from .binning import BinMapper
+from .dataset import BinnedDataset, FeatureGroupInfo, Metadata
+
+__all__ = ["BinMapper", "BinnedDataset", "FeatureGroupInfo", "Metadata"]

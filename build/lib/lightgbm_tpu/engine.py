@@ -1,0 +1,296 @@
+"""Training entry points ``train()`` and ``cv()``
+(reference ``python-package/lightgbm/engine.py:19-501``)."""
+
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Booster, Dataset
+from .config import normalize_params
+from .utils.log import LightGBMError, log_warning
+
+__all__ = ["train", "cv"]
+
+
+def train(params, train_set, num_boost_round=100, valid_sets=None,
+          valid_names=None, fobj=None, feval=None, init_model=None,
+          feature_name="auto", categorical_feature="auto",
+          early_stopping_rounds=None, evals_result=None, verbose_eval=True,
+          learning_rates=None, keep_training_booster=False, callbacks=None):
+    """Train one booster (reference engine.py:19-240)."""
+    params = normalize_params(params)
+    if fobj is not None:
+        params["objective"] = "none"
+    num_boost_round = params.pop("num_iterations", num_boost_round) \
+        if "num_iterations" in params else num_boost_round
+    if num_boost_round <= 0:
+        raise ValueError("num_boost_round should be greater than zero.")
+
+    if not isinstance(train_set, Dataset):
+        raise TypeError("Training only accepts Dataset object")
+    if feature_name != "auto":
+        train_set.set_feature_name(feature_name)
+    if categorical_feature != "auto":
+        train_set.set_categorical_feature(categorical_feature)
+    train_set.params = {**params, **train_set.params} \
+        if train_set._handle is None else train_set.params
+
+    init_iter = 0
+    booster = Booster(params=params, train_set=train_set)
+    if init_model is not None:
+        booster = _continue_from(init_model, params, train_set)
+        init_iter = booster._gbdt.num_init_iteration
+
+    is_valid_contain_train = False
+    train_data_name = "training"
+    if valid_sets is not None:
+        if isinstance(valid_sets, Dataset):
+            valid_sets = [valid_sets]
+        valid_names = valid_names or [f"valid_{i}"
+                                      for i in range(len(valid_sets))]
+        for i, vs in enumerate(valid_sets):
+            if vs is train_set:
+                is_valid_contain_train = True
+                train_data_name = valid_names[i]
+                continue
+            if vs.reference is None:
+                vs.reference = train_set
+            booster.add_valid(vs, valid_names[i])
+
+    cbs = set(callbacks or [])
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        cbs.add(callback_mod.early_stopping(
+            early_stopping_rounds,
+            verbose=bool(verbose_eval)))
+    if verbose_eval is True:
+        cbs.add(callback_mod.print_evaluation())
+    elif isinstance(verbose_eval, int) and verbose_eval is not False:
+        cbs.add(callback_mod.print_evaluation(verbose_eval))
+    if evals_result is not None:
+        cbs.add(callback_mod.record_evaluation(evals_result))
+    if learning_rates is not None:
+        cbs.add(callback_mod.reset_parameter(learning_rate=learning_rates))
+
+    cbs_before = [cb for cb in cbs
+                  if getattr(cb, "before_iteration", False)]
+    cbs_after = [cb for cb in cbs
+                 if not getattr(cb, "before_iteration", False)]
+    cbs_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    cbs_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    metric_freq = int(params.get("metric_freq", 1) or 1)
+    for i in range(init_iter, init_iter + num_boost_round):
+        for cb in cbs_before:
+            cb(callback_mod.CallbackEnv(
+                model=booster, params=params, iteration=i,
+                begin_iteration=init_iter,
+                end_iteration=init_iter + num_boost_round,
+                evaluation_result_list=None))
+        finished = booster.update(fobj=fobj)
+
+        evaluation_result_list = []
+        if (i + 1) % metric_freq == 0 or i == init_iter + num_boost_round - 1:
+            if is_valid_contain_train:
+                evaluation_result_list.extend(
+                    [(train_data_name, n, v, b)
+                     for _, n, v, b in booster.eval_train(feval)])
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        try:
+            for cb in cbs_after:
+                cb(callback_mod.CallbackEnv(
+                    model=booster, params=params, iteration=i,
+                    begin_iteration=init_iter,
+                    end_iteration=init_iter + num_boost_round,
+                    evaluation_result_list=evaluation_result_list))
+        except callback_mod.EarlyStopException as es:
+            booster.best_iteration = es.best_iteration + 1
+            evaluation_result_list = es.best_score
+            break
+        if finished:
+            break
+
+    booster.best_score = collections.defaultdict(collections.OrderedDict)
+    for rec in (evaluation_result_list or []):
+        booster.best_score[rec[0]][rec[1]] = rec[2]
+    if not keep_training_booster:
+        booster._train_set = None
+    return booster
+
+
+def _continue_from(init_model, params, train_set):
+    """Continued training: load model, use its predictions as init score
+    (reference boosting.cpp:15-28, engine.py init_model handling)."""
+    if isinstance(init_model, str):
+        prev = Booster(model_file=init_model, params=params)
+    elif isinstance(init_model, Booster):
+        prev = Booster(model_str=init_model.model_to_string(), params=params)
+    else:
+        raise TypeError("init_model should be a Booster or a model file path")
+    train_set.construct()
+    raw_source = train_set.raw
+    if raw_source is None:
+        raise LightGBMError(
+            "continued training needs raw data: construct the Dataset with "
+            "free_raw_data=False")
+    init_score = prev._gbdt.predict_raw(raw_source)
+    md = train_set._handle.metadata
+    # predict_raw returns (num_model, N); Metadata stores class-major
+    # [k*N + i] like the reference (basic.py _set_init_score_by_predictor
+    # regroups to exactly this layout)
+    md.set_init_score(init_score.reshape(-1))
+    booster = Booster(params=params, train_set=train_set)
+    booster._gbdt.models = list(prev._gbdt.models)
+    booster._gbdt.num_init_iteration = prev._gbdt.num_iterations()
+    booster._gbdt.iter = 0
+    return booster
+
+
+# ---------------------------------------------------------------------------
+# cross validation (reference engine.py:262-501)
+# ---------------------------------------------------------------------------
+
+def _make_n_folds(full_data, folds, nfold, params, seed, stratified,
+                  shuffle):
+    full_data.construct()
+    num_data = full_data.num_data()
+    if folds is not None:
+        if not hasattr(folds, "__iter__") and not hasattr(folds, "split"):
+            raise AttributeError(
+                "folds should be a generator or iterator of (train_idx, "
+                "test_idx) tuples or scikit-learn splitter object")
+        if hasattr(folds, "split"):
+            group = full_data.get_group()
+            group_info = (np.repeat(np.arange(len(group)), group)
+                          if group is not None else None)
+            folds = folds.split(X=np.zeros(num_data),
+                                y=full_data.get_label(), groups=group_info)
+    else:
+        group = full_data.get_group()
+        if group is not None:
+            # group-aware folds: split by query
+            ng = len(group)
+            rng = np.random.RandomState(seed)
+            order = rng.permutation(ng) if shuffle else np.arange(ng)
+            boundaries = np.concatenate([[0], np.cumsum(group)])
+            flocs = np.array_split(order, nfold)
+            folds = []
+            for f in flocs:
+                test_idx = np.concatenate(
+                    [np.arange(boundaries[q], boundaries[q + 1])
+                     for q in f]) if len(f) else np.empty(0, np.int64)
+                mask = np.ones(num_data, bool)
+                mask[test_idx.astype(np.int64)] = False
+                folds.append((np.nonzero(mask)[0], test_idx.astype(np.int64)))
+        elif stratified:
+            from sklearn.model_selection import StratifiedKFold
+            skf = StratifiedKFold(n_splits=nfold, shuffle=shuffle,
+                                  random_state=seed if shuffle else None)
+            folds = list(skf.split(np.zeros(num_data),
+                                   full_data.get_label()))
+        else:
+            rng = np.random.RandomState(seed)
+            order = rng.permutation(num_data) if shuffle \
+                else np.arange(num_data)
+            folds = [(np.setdiff1d(order, chunk, assume_unique=False), chunk)
+                     for chunk in np.array_split(order, nfold)]
+    ret = []
+    for train_idx, test_idx in folds:
+        train_sub = full_data.subset(np.sort(train_idx))
+        test_sub = full_data.subset(np.sort(test_idx))
+        ret.append((train_sub, test_sub))
+    return ret
+
+
+def cv(params, train_set, num_boost_round=100, folds=None, nfold=5,
+       stratified=True, shuffle=True, metrics=None, fobj=None, feval=None,
+       init_model=None, feature_name="auto", categorical_feature="auto",
+       early_stopping_rounds=None, fpreproc=None, verbose_eval=None,
+       show_stdv=True, seed=0, callbacks=None):
+    """K-fold cross validation; returns {metric-mean: [...],
+    metric-stdv: [...]} (reference engine.py:262-501)."""
+    params = normalize_params(params)
+    if fobj is not None:
+        params["objective"] = "none"
+    if metrics is not None:
+        params["metric"] = metrics
+    if train_set.get_label() is None and train_set.label is None:
+        raise LightGBMError("labels should not be None in cv")
+    if stratified and train_set.get_group() is not None:
+        stratified = False
+    if stratified:
+        label = train_set.construct().get_label()
+        # stratification needs classification-style labels
+        if len(np.unique(label)) > max(2, int(params.get("num_class", 1))) \
+                and params.get("objective", "regression").startswith(
+                    ("regression", "huber", "fair", "poisson", "quantile",
+                     "mape", "gamma", "tweedie")):
+            stratified = False
+
+    folds_data = _make_n_folds(train_set, folds, nfold, params, seed,
+                               stratified, shuffle)
+    boosters = []
+    for train_sub, test_sub in folds_data:
+        if fpreproc is not None:
+            train_sub, test_sub, tparams = fpreproc(train_sub, test_sub,
+                                                    params.copy())
+        else:
+            tparams = params
+        bst = Booster(params=tparams, train_set=train_sub)
+        bst.add_valid(test_sub, "valid")
+        boosters.append(bst)
+
+    results = collections.defaultdict(list)
+    cbs = set(callbacks or [])
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        cbs.add(callback_mod.early_stopping(early_stopping_rounds,
+                                            verbose=False))
+    if verbose_eval is True:
+        cbs.add(callback_mod.print_evaluation(show_stdv=show_stdv))
+    elif isinstance(verbose_eval, int) and verbose_eval not in (False, None):
+        cbs.add(callback_mod.print_evaluation(verbose_eval, show_stdv))
+    cbs = sorted(cbs, key=lambda cb: getattr(cb, "order", 0))
+
+    class _CVBooster:
+        def __init__(self, boosters):
+            self.boosters = boosters
+
+        def reset_parameter(self, new_params):
+            for b in self.boosters:
+                b.reset_parameter(new_params)
+
+    cvbooster = _CVBooster(boosters)
+    for i in range(num_boost_round):
+        for bst in boosters:
+            bst.update(fobj=fobj)
+        merged = collections.defaultdict(list)
+        order = []
+        bigger = {}
+        for bst in boosters:
+            for dname, mname, val, b in bst.eval_valid(feval):
+                key = f"{dname} {mname}"
+                if key not in merged:
+                    order.append(key)
+                merged[key].append(val)
+                bigger[key] = b
+        agg = [(k.split(" ", 1)[0], k.split(" ", 1)[1],
+                float(np.mean(merged[k])), bigger[k],
+                float(np.std(merged[k]))) for k in order]
+        for _, name, mean, _, std in agg:
+            results[f"{name}-mean"].append(mean)
+            results[f"{name}-stdv"].append(std)
+        try:
+            for cb in cbs:
+                cb(callback_mod.CallbackEnv(
+                    model=cvbooster, params=params, iteration=i,
+                    begin_iteration=0, end_iteration=num_boost_round,
+                    evaluation_result_list=agg))
+        except callback_mod.EarlyStopException as es:
+            for k in results:
+                results[k] = results[k][:es.best_iteration + 1]
+            break
+    return dict(results)

@@ -1,0 +1,407 @@
+"""Evaluation metrics (reference ``src/metric/``, factory ``metric.cpp:1-58``).
+
+Host-side numpy implementations; scores arrive as (num_model, N) float64 raw
+scores, objectives provide the output transformation where the reference does
+(sigmoid / exp / softmax).  Every metric exposes ``bigger_is_better`` used by
+early stopping (gbdt.cpp:518).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils.log import LightGBMError, log_warning
+
+
+class Metric:
+    name = "metric"
+    bigger_is_better = False
+
+    def __init__(self, config):
+        self.config = config
+
+    def init(self, metadata, num_data):
+        self.num_data = num_data
+        self.label = np.asarray(metadata.label, np.float64) \
+            if metadata.label is not None else np.zeros(num_data)
+        self.weights = (np.asarray(metadata.weights, np.float64)
+                        if metadata.weights is not None else None)
+        self.sum_weights = (float(self.weights.sum())
+                            if self.weights is not None else float(num_data))
+        self.metadata = metadata
+
+    def eval(self, score, objective):
+        """score: (num_model, N) raw; returns [(name, value)]."""
+        raise NotImplementedError
+
+    def _avg(self, losses):
+        if self.weights is None:
+            return float(np.mean(losses))
+        return float(np.sum(losses * self.weights) / self.sum_weights)
+
+
+def _convert(score, objective):
+    if objective is not None:
+        return objective.convert_output(score)
+    return score
+
+
+# ---------------------------------------------------------------------------
+# regression metrics (regression_metric.hpp:108-300)
+# ---------------------------------------------------------------------------
+
+class _PointwiseMetric(Metric):
+    def eval(self, score, objective):
+        pred = _convert(score[0], objective)
+        return [(self.name, self._point(pred))]
+
+    def _point(self, pred):
+        raise NotImplementedError
+
+
+class L2Metric(_PointwiseMetric):
+    name = "l2"
+
+    def _point(self, pred):
+        return self._avg((pred - self.label) ** 2)
+
+
+class RMSEMetric(_PointwiseMetric):
+    name = "rmse"
+
+    def _point(self, pred):
+        return float(np.sqrt(self._avg((pred - self.label) ** 2)))
+
+
+class L1Metric(_PointwiseMetric):
+    name = "l1"
+
+    def _point(self, pred):
+        return self._avg(np.abs(pred - self.label))
+
+
+class QuantileMetric(_PointwiseMetric):
+    name = "quantile"
+
+    def _point(self, pred):
+        a = float(self.config.alpha)
+        d = self.label - pred
+        return self._avg(np.where(d >= 0, a * d, (a - 1) * d))
+
+
+class HuberMetric(_PointwiseMetric):
+    name = "huber"
+
+    def _point(self, pred):
+        a = float(self.config.alpha)
+        d = np.abs(pred - self.label)
+        return self._avg(np.where(d <= a, 0.5 * d * d, a * (d - 0.5 * a)))
+
+
+class FairMetric(_PointwiseMetric):
+    name = "fair"
+
+    def _point(self, pred):
+        c = float(self.config.fair_c)
+        x = np.abs(pred - self.label)
+        return self._avg(c * c * (x / c - np.log1p(x / c)))
+
+
+class PoissonMetric(_PointwiseMetric):
+    name = "poisson"
+
+    def _point(self, pred):
+        eps = 1e-10
+        p = np.maximum(pred, eps)
+        return self._avg(p - self.label * np.log(p))
+
+
+class MapeMetric(_PointwiseMetric):
+    name = "mape"
+
+    def _point(self, pred):
+        return self._avg(np.abs((self.label - pred)
+                                / np.maximum(1.0, np.abs(self.label))))
+
+
+class GammaMetric(_PointwiseMetric):
+    """Gamma NLL with unit shape: label/score + log(score)
+    (regression_metric.hpp GammaMetric::LossOnPoint)."""
+
+    name = "gamma"
+
+    def _point(self, pred):
+        x = np.maximum(pred, 1e-10)
+        return self._avg(self.label / x + np.log(x))
+
+
+class GammaDevianceMetric(_PointwiseMetric):
+    name = "gamma_deviance"
+
+    def _point(self, pred):
+        ratio = self.label / (pred + 1e-9)
+        return self._avg(ratio - np.log(np.maximum(ratio, 1e-300)) - 1.0)
+
+
+class TweedieMetric(_PointwiseMetric):
+    name = "tweedie"
+
+    def _point(self, pred):
+        rho = float(self.config.tweedie_variance_power)
+        eps = 1e-10
+        p = np.maximum(pred, eps)
+        a = self.label * np.power(p, 1.0 - rho) / (1.0 - rho)
+        b = np.power(p, 2.0 - rho) / (2.0 - rho)
+        return self._avg(-a + b)
+
+
+# ---------------------------------------------------------------------------
+# binary metrics (binary_metric.hpp)
+# ---------------------------------------------------------------------------
+
+class BinaryLoglossMetric(_PointwiseMetric):
+    name = "binary_logloss"
+
+    def _point(self, prob):
+        eps = 1e-15
+        p = np.clip(prob, eps, 1 - eps)
+        y = (self.label > 0)
+        return self._avg(np.where(y, -np.log(p), -np.log(1 - p)))
+
+
+class BinaryErrorMetric(_PointwiseMetric):
+    name = "binary_error"
+
+    def _point(self, prob):
+        y = (self.label > 0)
+        pred_pos = prob > 0.5
+        return self._avg((pred_pos != y).astype(np.float64))
+
+
+class AUCMetric(Metric):
+    """Weighted AUC via rank-sum over descending predictions
+    (binary_metric.hpp:157-266)."""
+
+    name = "auc"
+    bigger_is_better = True
+
+    def eval(self, score, objective):
+        pred = score[0]          # AUC is monotone-invariant: raw score is fine
+        y = (self.label > 0)
+        w = self.weights if self.weights is not None \
+            else np.ones_like(pred)
+        order = np.argsort(pred, kind="mergesort")
+        ys, ws, ps = y[order], w[order], pred[order]
+        # handle ties: group by identical prediction
+        cum_pos = 0.0
+        cum_neg = 0.0
+        auc = 0.0
+        i = 0
+        n = len(ps)
+        while i < n:
+            j = i
+            tie_pos = 0.0
+            tie_neg = 0.0
+            while j < n and ps[j] == ps[i]:
+                if ys[j]:
+                    tie_pos += ws[j]
+                else:
+                    tie_neg += ws[j]
+                j += 1
+            auc += tie_pos * (cum_neg + tie_neg * 0.5)
+            cum_pos += tie_pos
+            cum_neg += tie_neg
+            i = j
+        denom = cum_pos * cum_neg
+        return [(self.name, float(auc / denom) if denom > 0 else 1.0)]
+
+
+# ---------------------------------------------------------------------------
+# multiclass metrics (multiclass_metric.hpp)
+# ---------------------------------------------------------------------------
+
+class MultiLoglossMetric(Metric):
+    name = "multi_logloss"
+
+    def eval(self, score, objective):
+        prob = _convert(score, objective)      # (K, N)
+        eps = 1e-15
+        li = self.label.astype(np.int64)
+        p = np.clip(prob[li, np.arange(len(li))], eps, None)
+        return [(self.name, self._avg(-np.log(p)))]
+
+
+class MultiErrorMetric(Metric):
+    name = "multi_error"
+
+    def eval(self, score, objective):
+        li = self.label.astype(np.int64)
+        pred = np.argmax(score, axis=0)
+        return [(self.name, self._avg((pred != li).astype(np.float64)))]
+
+
+# ---------------------------------------------------------------------------
+# cross-entropy metrics (xentropy_metric.hpp)
+# ---------------------------------------------------------------------------
+
+class CrossEntropyMetric(_PointwiseMetric):
+    name = "cross_entropy"
+
+    def _point(self, prob):
+        eps = 1e-15
+        p = np.clip(prob, eps, 1 - eps)
+        y = self.label
+        return self._avg(-(y * np.log(p) + (1 - y) * np.log(1 - p)))
+
+
+class CrossEntropyLambdaMetric(Metric):
+    name = "cross_entropy_lambda"
+
+    def eval(self, score, objective):
+        # loss on point with hhat = log1p(exp(f)) (xentropy_metric.hpp)
+        f = score[0]
+        hhat = np.log1p(np.exp(f))
+        y = self.label
+        w = self.weights if self.weights is not None else 1.0
+        z = 1.0 - np.exp(-w * hhat)
+        eps = 1e-15
+        z = np.clip(z, eps, 1 - eps)
+        loss = -(y * np.log(z) + (1 - y) * np.log(1 - z))
+        return [(self.name, float(np.mean(loss)))]
+
+
+class KLDivMetric(_PointwiseMetric):
+    name = "kullback_leibler"
+
+    def _point(self, prob):
+        eps = 1e-15
+        p = np.clip(prob, eps, 1 - eps)
+        y = np.clip(self.label, eps, 1 - eps)
+        return self._avg(y * np.log(y / p)
+                         + (1 - y) * np.log((1 - y) / (1 - p)))
+
+
+# ---------------------------------------------------------------------------
+# ranking metrics (rank_metric.hpp, map_metric.hpp, dcg_calculator.cpp)
+# ---------------------------------------------------------------------------
+
+class NDCGMetric(Metric):
+    name = "ndcg"
+    bigger_is_better = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            raise LightGBMError("The NDCG metric requires query information")
+        self.qb = metadata.query_boundaries
+        self.eval_at = [int(k) for k in (self.config.eval_at or [1, 2, 3, 4, 5])]
+        gains = list(self.config.label_gain or [])
+        if not gains:
+            gains = [float((1 << i) - 1) for i in range(31)]
+        self.gains = np.asarray(gains, np.float64)
+        self.query_weights = metadata.query_weights
+
+    def eval(self, score, objective):
+        pred = score[0]
+        ks = self.eval_at
+        nq = len(self.qb) - 1
+        res = np.zeros((len(ks), nq))
+        for q in range(nq):
+            lo, hi = self.qb[q], self.qb[q + 1]
+            labels = self.label[lo:hi].astype(np.int64)
+            order = np.argsort(-pred[lo:hi], kind="stable")
+            sorted_gain = self.gains[labels[order]]
+            ideal_gain = np.sort(self.gains[labels])[::-1]
+            disc = 1.0 / np.log2(np.arange(2, 2 + hi - lo))
+            for ki, k in enumerate(ks):
+                kk = min(k, hi - lo)
+                maxdcg = float((ideal_gain[:kk] * disc[:kk]).sum())
+                if maxdcg <= 0.0:
+                    res[ki, q] = 1.0
+                else:
+                    dcg = float((sorted_gain[:kk] * disc[:kk]).sum())
+                    res[ki, q] = dcg / maxdcg
+        if self.query_weights is not None:
+            qw = np.asarray(self.query_weights, np.float64)
+            vals = (res * qw).sum(axis=1) / qw.sum()
+        else:
+            vals = res.mean(axis=1)
+        return [(f"ndcg@{k}", float(v)) for k, v in zip(ks, vals)]
+
+
+class MapMetric(Metric):
+    name = "map"
+    bigger_is_better = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            raise LightGBMError("The MAP metric requires query information")
+        self.qb = metadata.query_boundaries
+        self.eval_at = [int(k) for k in (self.config.eval_at or [1, 2, 3, 4, 5])]
+        self.query_weights = metadata.query_weights
+
+    def eval(self, score, objective):
+        pred = score[0]
+        ks = self.eval_at
+        nq = len(self.qb) - 1
+        res = np.zeros((len(ks), nq))
+        for q in range(nq):
+            lo, hi = self.qb[q], self.qb[q + 1]
+            rel = (self.label[lo:hi] > 0)
+            order = np.argsort(-pred[lo:hi], kind="stable")
+            rel_sorted = rel[order]
+            hits = np.cumsum(rel_sorted)
+            prec = hits / np.arange(1, hi - lo + 1)
+            for ki, k in enumerate(ks):
+                kk = min(k, hi - lo)
+                nrel = int(rel_sorted[:kk].sum())
+                if nrel == 0:
+                    res[ki, q] = 1.0 if rel.sum() == 0 else 0.0
+                else:
+                    res[ki, q] = float(
+                        (prec[:kk] * rel_sorted[:kk]).sum() / nrel)
+        if self.query_weights is not None:
+            qw = np.asarray(self.query_weights, np.float64)
+            vals = (res * qw).sum(axis=1) / qw.sum()
+        else:
+            vals = res.mean(axis=1)
+        return [(f"map@{k}", float(v)) for k, v in zip(ks, vals)]
+
+
+# ---------------------------------------------------------------------------
+
+_REGISTRY = {
+    "l1": L1Metric,
+    "l2": L2Metric,
+    "rmse": RMSEMetric,
+    "quantile": QuantileMetric,
+    "huber": HuberMetric,
+    "fair": FairMetric,
+    "poisson": PoissonMetric,
+    "mape": MapeMetric,
+    "gamma": GammaMetric,
+    "gamma_deviance": GammaDevianceMetric,
+    "tweedie": TweedieMetric,
+    "binary_logloss": BinaryLoglossMetric,
+    "binary_error": BinaryErrorMetric,
+    "auc": AUCMetric,
+    "multi_logloss": MultiLoglossMetric,
+    "multi_error": MultiErrorMetric,
+    "cross_entropy": CrossEntropyMetric,
+    "cross_entropy_lambda": CrossEntropyLambdaMetric,
+    "kullback_leibler": KLDivMetric,
+    "ndcg": NDCGMetric,
+    "map": MapMetric,
+}
+
+
+def create_metric(name, config):
+    cls = _REGISTRY.get(name)
+    if cls is None:
+        raise LightGBMError(f"unknown metric: {name}")
+    return cls(config)
+
+
+def create_metrics(config):
+    return [create_metric(m, config) for m in config.metric]

@@ -1,0 +1,41 @@
+"""Objective functions: gradients/hessians on device.
+
+Factory mirrors the reference ``CreateObjectiveFunction``
+(``src/objective/objective_function.cpp:1-88``).
+"""
+
+from .base import ObjectiveFunction
+from .regression import (RegressionL2, RegressionL1, Huber, Fair, Poisson,
+                         Quantile, Mape, Gamma, Tweedie)
+from .binary import BinaryLogloss
+from .multiclass import MulticlassSoftmax, MulticlassOVA
+from .xentropy import CrossEntropy, CrossEntropyLambda
+from .rank import LambdarankNDCG
+
+_REGISTRY = {
+    "regression": RegressionL2,
+    "regression_l1": RegressionL1,
+    "huber": Huber,
+    "fair": Fair,
+    "poisson": Poisson,
+    "quantile": Quantile,
+    "mape": Mape,
+    "gamma": Gamma,
+    "tweedie": Tweedie,
+    "binary": BinaryLogloss,
+    "multiclass": MulticlassSoftmax,
+    "multiclassova": MulticlassOVA,
+    "cross_entropy": CrossEntropy,
+    "cross_entropy_lambda": CrossEntropyLambda,
+    "lambdarank": LambdarankNDCG,
+}
+
+
+def create_objective(config):
+    name = config.objective
+    if name in ("none", "null", "custom", "na"):
+        return None
+    cls = _REGISTRY.get(name)
+    if cls is None:
+        raise ValueError(f"unknown objective: {name}")
+    return cls(config)

@@ -1,0 +1,101 @@
+"""Objective base class (reference ``include/LightGBM/objective_function.h``).
+
+Scores are device arrays of shape (num_model, N) — the analog of the
+reference's class-major flat layout.  ``get_gradients`` returns device
+(num_model, N) float32 (grad, hess); everything elementwise runs jitted.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class ObjectiveFunction:
+    name = "none"
+    is_constant_hessian = False
+    is_renew_tree_output = False
+
+    def __init__(self, config):
+        self.config = config
+
+    @property
+    def num_model_per_iteration(self) -> int:
+        return 1
+
+    def init(self, metadata, num_data: int):
+        self.num_data = num_data
+        self.label = np.asarray(metadata.label, np.float32) \
+            if metadata.label is not None else np.zeros(num_data, np.float32)
+        self.weights = (np.asarray(metadata.weights, np.float32)
+                        if metadata.weights is not None else None)
+        self.label_d = jnp.asarray(self.label)
+        self.weights_d = (jnp.asarray(self.weights)
+                          if self.weights is not None else None)
+
+    def get_gradients(self, scores) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        raise NotImplementedError
+
+    def boost_from_score(self, class_id: int) -> float:
+        """Initial score (BoostFromScore)."""
+        return 0.0
+
+    def class_need_train(self, class_id: int) -> bool:
+        return True
+
+    def convert_output(self, raw: np.ndarray) -> np.ndarray:
+        """Raw score -> user-facing prediction (ConvertOutput)."""
+        return raw
+
+    def renew_tree_output(self, leaf_pred: float, residual_fn) -> float:
+        """Per-leaf output renewal for percentile-style objectives."""
+        raise NotImplementedError
+
+    def to_string(self) -> str:
+        return self.name
+
+    def _w(self, x):
+        return x if self.weights_d is None else x * self.weights_d
+
+
+def percentile(data: np.ndarray, alpha: float) -> float:
+    """Reference PercentileFun (regression_objective.hpp:11-36): descending
+    order, float position (1-alpha)*cnt, linear interpolation."""
+    data = np.asarray(data, np.float64)
+    cnt = len(data)
+    if cnt == 0:
+        return 0.0
+    d = np.sort(data)[::-1]
+    float_pos = (1.0 - alpha) * cnt
+    pos = int(float_pos)
+    if pos < 1:
+        return float(d[0])
+    if pos >= cnt:
+        return float(d[-1])
+    bias = float_pos - pos
+    return float(d[pos - 1] - (d[pos - 1] - d[pos]) * bias)
+
+
+def weighted_percentile(data: np.ndarray, weights: np.ndarray,
+                        alpha: float) -> float:
+    """Reference WeightedPercentileFun (regression_objective.hpp:39-59) with
+    a bounds-safe interpolation (the reference indexes one past the cdf when
+    the threshold lands in the final interval)."""
+    data = np.asarray(data, np.float64)
+    cnt = len(data)
+    if cnt == 0:
+        return 0.0
+    order = np.argsort(data, kind="stable")
+    cdf = np.cumsum(np.asarray(weights, np.float64)[order])
+    thr = cdf[-1] * alpha
+    pos = int(np.searchsorted(cdf, thr, side="right"))
+    if pos == 0:
+        return float(data[order[0]])
+    if pos >= cnt:
+        return float(data[order[-1]])
+    v1, v2 = data[order[pos - 1]], data[order[pos]]
+    denom = cdf[pos] - cdf[pos - 1]
+    frac = (thr - cdf[pos - 1]) / denom if denom > 0 else 0.0
+    return float(v1 + frac * (v2 - v1))

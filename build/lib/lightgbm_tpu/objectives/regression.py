@@ -1,0 +1,278 @@
+"""Regression objectives (reference ``src/objective/regression_objective.hpp``).
+
+All gradients are elementwise jitted device ops; ``score`` arrives as a
+(1, N) device array and (grad, hess) leave the same shape.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.log import LightGBMError, log_warning
+from .base import ObjectiveFunction, percentile, weighted_percentile
+
+
+def _sign(x):
+    return jnp.where(x > 0, 1.0, jnp.where(x < 0, -1.0, 0.0))
+
+
+class RegressionL2(ObjectiveFunction):
+    """L2 loss; grad = (score - label) [* w], hess = 1 [* w]
+    (regression_objective.hpp:64-140)."""
+
+    name = "regression"
+    is_constant_hessian = True
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sqrt = bool(getattr(config, "reg_sqrt", False))
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if self.sqrt:
+            self.label = (np.sign(self.label)
+                          * np.sqrt(np.abs(self.label))).astype(np.float32)
+            self.label_d = jnp.asarray(self.label)
+        self.is_constant_hessian = self.weights is None and \
+            type(self) is RegressionL2
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _grad(self, score, label, weights):
+        diff = score - label
+        w = jnp.ones_like(score) if weights is None else weights
+        return diff * w, w
+
+    def get_gradients(self, scores):
+        return self._grad(scores[0].astype(jnp.float32), self.label_d,
+                          self.weights_d)
+
+    def boost_from_score(self, class_id):
+        if self.weights is None:
+            return float(np.mean(self.label))
+        return float(np.sum(self.label * self.weights)
+                     / max(np.sum(self.weights), 1e-35))
+
+    def convert_output(self, raw):
+        if self.sqrt:
+            return np.sign(raw) * raw * raw
+        return raw
+
+    def to_string(self):
+        return self.name + (" sqrt" if self.sqrt else "")
+
+
+class RegressionL1(RegressionL2):
+    """L1: grad = sign(diff) [* w]; leaf outputs renewed to the weighted
+    median of residuals (regression_objective.hpp:175-258)."""
+
+    name = "regression_l1"
+    is_renew_tree_output = True
+    alpha = 0.5
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _grad(self, score, label, weights):
+        diff = score - label
+        w = jnp.ones_like(score) if weights is None else weights
+        return _sign(diff) * w, w
+
+    def boost_from_score(self, class_id):
+        if self.weights is None:
+            return percentile(self.label, self.alpha)
+        return weighted_percentile(self.label, self.weights, self.alpha)
+
+    def renew_tree_output(self, leaf_pred, residuals, weights):
+        if weights is None:
+            return percentile(residuals, self.alpha)
+        return weighted_percentile(residuals, weights, self.alpha)
+
+
+class Huber(RegressionL2):
+    """Huber loss with transition alpha (regression_objective.hpp:261-320)."""
+
+    name = "huber"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.alpha = float(config.alpha)
+        self.sqrt = False
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _grad(self, score, label, weights):
+        diff = score - label
+        g = jnp.where(jnp.abs(diff) <= self.alpha, diff,
+                      _sign(diff) * self.alpha)
+        w = jnp.ones_like(score) if weights is None else weights
+        return g * w, w
+
+
+class Fair(RegressionL2):
+    """Fair loss (regression_objective.hpp:323-369)."""
+
+    name = "fair"
+    is_constant_hessian = False
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.c = float(config.fair_c)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        self.is_constant_hessian = False
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _grad(self, score, label, weights):
+        x = score - label
+        ax = jnp.abs(x)
+        g = self.c * x / (ax + self.c)
+        h = self.c * self.c / ((ax + self.c) ** 2)
+        if weights is not None:
+            g, h = g * weights, h * weights
+        return g, h
+
+
+class Poisson(RegressionL2):
+    """Poisson with log link: grad = exp(s) - y, hess = exp(s + mds)
+    (regression_objective.hpp:371-450)."""
+
+    name = "poisson"
+    is_constant_hessian = False
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.max_delta_step = float(config.poisson_max_delta_step)
+        self.sqrt = False
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        self.is_constant_hessian = False
+        if (self.label < 0).any():
+            raise LightGBMError(
+                f"[{self.name}]: at least one target label is negative")
+        if self.label.sum() == 0:
+            raise LightGBMError(f"[{self.name}]: sum of labels is zero")
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _grad(self, score, label, weights):
+        g = jnp.exp(score) - label
+        h = jnp.exp(score + self.max_delta_step)
+        if weights is not None:
+            g, h = g * weights, h * weights
+        return g, h
+
+    def boost_from_score(self, class_id):
+        return float(np.log(max(RegressionL2.boost_from_score(self, 0),
+                                1e-35)))
+
+    def convert_output(self, raw):
+        return np.exp(raw)
+
+
+class Quantile(RegressionL2):
+    """Pinball loss at quantile alpha (regression_objective.hpp:452-549)."""
+
+    name = "quantile"
+    is_renew_tree_output = True
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.alpha = float(config.alpha)
+        if not (0.0 < self.alpha < 1.0):
+            raise LightGBMError("alpha should be in (0, 1) for quantile")
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _grad(self, score, label, weights):
+        delta = score - label
+        g = jnp.where(delta >= 0, 1.0 - self.alpha, -self.alpha)
+        w = jnp.ones_like(score) if weights is None else weights
+        return g * w, w
+
+    def boost_from_score(self, class_id):
+        if self.weights is None:
+            return percentile(self.label, self.alpha)
+        return weighted_percentile(self.label, self.weights, self.alpha)
+
+    def renew_tree_output(self, leaf_pred, residuals, weights):
+        if weights is None:
+            return percentile(residuals, self.alpha)
+        return weighted_percentile(residuals, weights, self.alpha)
+
+
+class Mape(RegressionL1):
+    """MAPE: sign(diff) / max(1, |y|) with median renewal weighted by the
+    label weight (regression_objective.hpp:551-650)."""
+
+    name = "mape"
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if (np.abs(self.label) < 1).any():
+            log_warning("Met 'abs(label) < 1', will convert them to '1' in "
+                        "MAPE objective and metric")
+        lw = 1.0 / np.maximum(1.0, np.abs(self.label))
+        if self.weights is not None:
+            lw = lw * self.weights
+        self.label_weight = lw.astype(np.float32)
+        self.label_weight_d = jnp.asarray(self.label_weight)
+
+    def get_gradients(self, scores):
+        return self._grad_mape(scores[0].astype(jnp.float32), self.label_d,
+                               self.label_weight_d, self.weights_d)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _grad_mape(self, score, label, label_weight, weights):
+        diff = score - label
+        g = _sign(diff) * label_weight
+        h = jnp.ones_like(score) if weights is None else weights
+        return g, h
+
+    def boost_from_score(self, class_id):
+        return weighted_percentile(self.label, self.label_weight, 0.5)
+
+    def renew_tree_output(self, leaf_pred, residuals, weights):
+        # weights passed here are the label weights of the leaf rows
+        return weighted_percentile(residuals, weights, 0.5)
+
+
+class Gamma(Poisson):
+    """Gamma deviance with log link (regression_objective.hpp:652-687)."""
+
+    name = "gamma"
+
+    def init(self, metadata, num_data):
+        RegressionL2.init(self, metadata, num_data)
+        self.is_constant_hessian = False
+        if (self.label <= 0).any():
+            raise LightGBMError(
+                f"[{self.name}]: labels must be positive")
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _grad(self, score, label, weights):
+        g = 1.0 - label * jnp.exp(-score)
+        h = label * jnp.exp(-score)
+        if weights is not None:
+            g, h = g * weights, h * weights
+        return g, h
+
+
+class Tweedie(Poisson):
+    """Tweedie with variance power rho (regression_objective.hpp:689-740)."""
+
+    name = "tweedie"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.rho = float(config.tweedie_variance_power)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _grad(self, score, label, weights):
+        e1 = jnp.exp((1.0 - self.rho) * score)
+        e2 = jnp.exp((2.0 - self.rho) * score)
+        g = -label * e1 + e2
+        h = -label * (1.0 - self.rho) * e1 + (2.0 - self.rho) * e2
+        if weights is not None:
+            g, h = g * weights, h * weights
+        return g, h

@@ -1,0 +1,103 @@
+"""Cross-entropy objectives (reference ``src/objective/xentropy_objective.hpp``).
+
+``cross_entropy``: labels are probabilities in [0, 1]; grad = sigmoid(s) - y.
+``cross_entropy_lambda``: alternative parameterization with log(1+exp(s))
+intensity; weighted case follows the reference's closed forms.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.log import LightGBMError, log_info
+from .base import ObjectiveFunction
+
+K_EPSILON = 1e-15
+
+
+def _check_labels(label):
+    if (label < 0).any() or (label > 1).any():
+        raise LightGBMError("[cross-entropy]: labels must be in [0, 1]")
+
+
+class CrossEntropy(ObjectiveFunction):
+    name = "cross_entropy"
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        _check_labels(self.label)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _grad(self, score, label, weights):
+        z = jax.nn.sigmoid(score)
+        g = z - label
+        h = z * (1.0 - z)
+        if weights is not None:
+            g, h = g * weights, h * weights
+        return g, h
+
+    def get_gradients(self, scores):
+        return self._grad(scores[0].astype(jnp.float32), self.label_d,
+                          self.weights_d)
+
+    def boost_from_score(self, class_id):
+        w = self.weights if self.weights is not None \
+            else np.ones_like(self.label)
+        pavg = float((self.label * w).sum() / max(w.sum(), K_EPSILON))
+        pavg = min(max(pavg, K_EPSILON), 1.0 - K_EPSILON)
+        init = math.log(pavg / (1.0 - pavg))
+        log_info(f"[cross_entropy:BoostFromScore]: pavg={pavg:.6f} -> "
+                 f"initscore={init:.6f}")
+        return init
+
+    def convert_output(self, raw):
+        return 1.0 / (1.0 + np.exp(-raw))
+
+
+class CrossEntropyLambda(ObjectiveFunction):
+    name = "cross_entropy_lambda"
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        _check_labels(self.label)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _grad(self, score, label, weights):
+        if weights is None:
+            z = jax.nn.sigmoid(score)
+            return z - label, z * (1.0 - z)
+        # weighted closed form (xentropy_objective.hpp:190-208)
+        w, y = weights, label
+        epf = jnp.exp(score)
+        hhat = jnp.log1p(epf)
+        z = 1.0 - jnp.exp(-w * hhat)
+        enf = 1.0 / epf
+        g = (1.0 - y / jnp.maximum(z, K_EPSILON)) * w / (1.0 + enf)
+        c = 1.0 / jnp.maximum(1.0 - z, K_EPSILON)
+        d0 = 1.0 + epf
+        a = w * epf / (d0 * d0)
+        d = c - 1.0
+        b = (c / jnp.maximum(d * d, K_EPSILON)) * (1.0 + w * epf - c)
+        h = a * (1.0 + y * b)
+        return g, h
+
+    def get_gradients(self, scores):
+        return self._grad(scores[0].astype(jnp.float32), self.label_d,
+                          self.weights_d)
+
+    def boost_from_score(self, class_id):
+        w = self.weights if self.weights is not None \
+            else np.ones_like(self.label)
+        havg = float((self.label * w).sum() / max(w.sum(), K_EPSILON))
+        init = math.log(max(math.exp(havg) - 1.0, K_EPSILON))
+        log_info(f"[cross_entropy_lambda:BoostFromScore]: havg={havg:.6f} -> "
+                 f"initscore={init:.6f}")
+        return init
+
+    def convert_output(self, raw):
+        return np.log1p(np.exp(raw))

@@ -1,0 +1,13 @@
+"""Device-side ops: histogram construction, best-split scan, partition.
+
+These are the TPU-native replacements for the reference's hot loops
+(``src/io/dense_bin.hpp:106-175`` histogram gather,
+``src/treelearner/feature_histogram.hpp`` threshold scans,
+``src/treelearner/data_partition.hpp`` stable partition) — formulated as
+large batched matmuls / prefix scans / sorts that XLA tiles onto the MXU and
+VPU instead of scalar loops with atomics.
+"""
+
+from .histogram import build_histogram, subtract_histogram  # noqa: F401
+from .split import SplitContext, find_best_split  # noqa: F401
+from .partition import partition_leaf, goes_left_matrix  # noqa: F401

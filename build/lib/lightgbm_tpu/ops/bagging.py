@@ -1,0 +1,67 @@
+"""Device-side bagging / GOSS row selection.
+
+The reference builds bagging index arrays with per-thread reservoir splits
+(``gbdt.cpp:161-243``); here selection is a bernoulli mask + stable key-sort
+compaction, producing the same (buffer, count) contract the tree learner
+consumes.  GOSS (``goss.hpp:88-133``) keeps the top |g*h| rows and
+up-weights a bernoulli sample of the rest by (n - top_k) / other_k.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def bagging_partition(key, n_pad: int, num_data, fraction):
+    """Returns (buffer (n_pad,) int32 with selected rows first, count)."""
+    return _bagging_impl(key, int(n_pad),
+                         jnp.asarray(num_data, jnp.int32),
+                         jnp.asarray(fraction, jnp.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("n_pad",))
+def _bagging_impl(key, n_pad, num_data, fraction):
+    pos = jnp.arange(n_pad, dtype=jnp.int32)
+    valid = pos < num_data
+    u = jax.random.uniform(key, (n_pad,))
+    selected = valid & (u < fraction)
+    sort_key = jnp.where(selected, 0, jnp.where(valid, 1, 2))
+    order = jnp.argsort(sort_key.astype(jnp.int32), stable=True)
+    return order.astype(jnp.int32), selected.sum().astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("n_pad",))
+def goss_partition(key, grad_abs, n_pad, num_data, top_rate, other_rate):
+    """GOSS selection on |g*h| scores summed over classes.
+
+    Returns (buffer, count, multiplier_mask) where multiplier_mask is 1.0
+    for kept/top rows and (n-top_k)/other_k for sampled rest rows (applied
+    to grad AND hess by the caller, goss.hpp:117-126).
+    """
+    pos = jnp.arange(n_pad, dtype=jnp.int32)
+    valid = pos < num_data
+    scores = jnp.where(valid, grad_abs, -jnp.inf)
+    top_k = jnp.maximum(
+        (num_data.astype(jnp.float32) * top_rate).astype(jnp.int32), 1)
+    other_k = jnp.maximum(
+        (num_data.astype(jnp.float32) * other_rate).astype(jnp.int32), 1)
+    sorted_desc = jnp.sort(scores)[::-1]
+    threshold = sorted_desc[jnp.clip(top_k - 1, 0, n_pad - 1)]
+    is_top = valid & (grad_abs >= threshold)
+    rest = valid & ~is_top
+    n_rest = jnp.maximum(rest.sum(), 1)
+    prob = other_k.astype(jnp.float32) / n_rest.astype(jnp.float32)
+    u = jax.random.uniform(key, (n_pad,))
+    sampled = rest & (u < prob)
+    selected = is_top | sampled
+    multiplier = jnp.where(
+        sampled,
+        (num_data - top_k).astype(jnp.float32)
+        / other_k.astype(jnp.float32), 1.0)
+    sort_key = jnp.where(selected, 0, jnp.where(valid, 1, 2))
+    order = jnp.argsort(sort_key.astype(jnp.int32), stable=True)
+    return (order.astype(jnp.int32), selected.sum().astype(jnp.int32),
+            multiplier)

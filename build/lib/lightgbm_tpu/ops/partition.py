@@ -1,0 +1,109 @@
+"""Leaf partition + score application on device.
+
+Replaces the reference's multithreaded stable partition
+(``src/treelearner/data_partition.hpp:109-200``) with a key-sort compaction:
+rows of the split leaf get key 0 (left) / 1 (right), padded tail rows key 2,
+and a stable argsort yields the partitioned order with the tail untouched —
+so the padded window can be written back with ``dynamic_update_slice``
+without corrupting neighbouring leaf regions.
+
+Row routing mirrors ``DenseBin::Split`` (``src/io/dense_bin.hpp:190-250``):
+
+* rows whose group slot lies outside the split feature's slot range, or at
+  the feature's default bin, go to the "default" side — ``default_left`` for
+  MissingType::Zero, else by ``default_bin <= threshold``;
+* the NaN bin (MissingType::NaN) follows ``default_left``;
+* everything else compares ``bin <= threshold``;
+* categorical rows go left iff their bin is in the chosen category set
+  (default-bin rows included via membership of the default bin).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def _partition_kernel(binned, indices, start, count, group, offset, width,
+                      default_bin, num_bin, missing, threshold, default_left,
+                      is_cat, cat_member):
+    m = indices.shape[0]
+    pos = jnp.arange(m, dtype=jnp.int32)
+    valid = (pos >= start) & (pos < start + count)
+    idx = jnp.where(valid, indices, 0)
+    slot = binned[idx, group].astype(jnp.int32)
+
+    shift = jnp.where(default_bin == 0, 1, 0)
+    in_range = (slot >= offset) & (slot < offset + width)
+    bin_ = jnp.where(in_range, slot - offset + shift, default_bin)
+
+    is_default = bin_ == default_bin
+    is_na = (missing == 2) & (bin_ == num_bin - 1)
+    default_goes_left = jnp.where(missing == 1, default_left,
+                                  default_bin <= threshold)
+    left_num = jnp.where(is_default, default_goes_left,
+                         jnp.where(is_na, default_left, bin_ <= threshold))
+    left_cat = cat_member[jnp.clip(bin_, 0, 255)]
+    goes_left = jnp.where(is_cat, left_cat, left_num)
+
+    # head-foreign rows (pos < start) sort first, then left, right, tail
+    key = jnp.where(pos < start, 0,
+                    jnp.where(valid, jnp.where(goes_left, 1, 2), 3))
+    order = jnp.argsort(key.astype(jnp.int32), stable=True)
+    return indices[order], (valid & goes_left).sum().astype(jnp.int32)
+
+
+def partition_leaf(binned, indices, count, *, group, offset, width,
+                   default_bin, num_bin, missing, threshold, default_left,
+                   is_cat, cat_member, start=0):
+    """Stable-partition one leaf's (padded) index window.
+
+    Returns (reordered indices (M,), left_count scalar) as device values.
+    All split parameters are traced scalars: one compiled program per padded
+    window size M.
+    """
+    i32 = lambda v: jnp.asarray(v, jnp.int32)
+    return _partition_kernel(
+        binned, indices, i32(start), i32(count), i32(group), i32(offset),
+        i32(width), i32(default_bin), i32(num_bin), i32(missing),
+        i32(threshold), jnp.asarray(default_left, bool),
+        jnp.asarray(is_cat, bool), jnp.asarray(cat_member, bool))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def apply_leaf_outputs(score, indices, leaf_begin, leaf_values, valid_count):
+    """score[indices[p]] += leaf_values[leaf containing position p].
+
+    ``leaf_begin`` are the ascending region starts in partition-position
+    space, ``leaf_values`` the matching leaf outputs.  Positions at or past
+    ``valid_count`` (out-of-bag rows under bagging) receive no update.  This
+    is the train-side ``ScoreUpdater::AddScore`` via leaf partitions
+    (``score_updater.hpp``).
+    """
+    n = indices.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    leaf = jnp.searchsorted(leaf_begin, pos, side="right") - 1
+    addend = jnp.where(pos < valid_count, leaf_values[leaf], 0.0)
+    return score.at[indices].add(addend.astype(score.dtype))
+
+
+@jax.jit
+def goes_left_matrix(binned_rows, group, offset, width, default_bin, num_bin,
+                     missing, threshold, default_left, is_cat, cat_member):
+    """Vectorized left/right decision for arbitrary binned rows (used by the
+    on-device tree traversal in prediction)."""
+    slot = binned_rows[:, group].astype(jnp.int32)
+    shift = jnp.where(default_bin == 0, 1, 0)
+    in_range = (slot >= offset) & (slot < offset + width)
+    bin_ = jnp.where(in_range, slot - offset + shift, default_bin)
+    is_default = bin_ == default_bin
+    is_na = (missing == 2) & (bin_ == num_bin - 1)
+    default_goes_left = jnp.where(missing == 1, default_left,
+                                  default_bin <= threshold)
+    left_num = jnp.where(is_default, default_goes_left,
+                         jnp.where(is_na, default_left, bin_ <= threshold))
+    left_cat = cat_member[jnp.clip(bin_, 0, 255)]
+    return jnp.where(is_cat, left_cat, left_num)

@@ -1,0 +1,140 @@
+"""On-device tree traversal over a binned matrix.
+
+Vectorized replacement for the reference's per-row ``Tree::GetLeaf``
+traversal (``include/LightGBM/tree.h:487-508``, ``DecisionInner``): every row
+carries a node pointer; one ``lax.while_loop`` iteration advances all rows a
+level (gather node metadata, decode the feature bin from the group slot,
+branch).  Terminates at the true tree depth.  Used for validation-score
+updates, DART score subtraction and out-of-bag score updates — places where
+the training partition is unavailable.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tree.tree import K_CATEGORICAL_MASK, K_DEFAULT_LEFT_MASK, Tree
+
+
+class DeviceTree(NamedTuple):
+    """Flat device arrays for one tree, sized (max_nodes,) / (max_leaves,)."""
+    split_group: jnp.ndarray
+    offset: jnp.ndarray
+    width: jnp.ndarray
+    default_bin: jnp.ndarray
+    num_bin: jnp.ndarray
+    missing: jnp.ndarray
+    threshold: jnp.ndarray
+    default_left: jnp.ndarray
+    is_cat: jnp.ndarray
+    cat_bitset: jnp.ndarray      # (max_nodes, 8) uint32 over inner bins
+    left_child: jnp.ndarray
+    right_child: jnp.ndarray
+    leaf_value: jnp.ndarray
+
+
+def device_tree(tree: Tree, dataset, max_leaves: int) -> DeviceTree:
+    """Build device arrays from a host tree + dataset feature metadata."""
+    mn = max(max_leaves - 1, 1)
+    n = tree.num_leaves - 1
+    sg = np.zeros(mn, np.int32)
+    off = np.zeros(mn, np.int32)
+    wid = np.ones(mn, np.int32)
+    db = np.zeros(mn, np.int32)
+    nb = np.ones(mn, np.int32)
+    mi = np.zeros(mn, np.int32)
+    thr = np.zeros(mn, np.int32)
+    dl = np.zeros(mn, bool)
+    ic = np.zeros(mn, bool)
+    cb = np.zeros((mn, 8), np.uint32)
+    lc = np.full(mn, -1, np.int32)
+    rc = np.full(mn, -1, np.int32)
+    for node in range(n):
+        f = int(tree.split_feature_inner[node])
+        sg[node] = dataset.f_group[f]
+        off[node] = dataset.f_offset[f]
+        nbin = int(dataset.f_num_bin[f])
+        dbin = int(dataset.f_default_bin[f])
+        nb[node] = nbin
+        db[node] = dbin
+        wid[node] = nbin - (1 if dbin == 0 else 0)
+        dt = int(tree.decision_type[node])
+        ic[node] = bool(dt & K_CATEGORICAL_MASK)
+        dl[node] = bool(dt & K_DEFAULT_LEFT_MASK)
+        mi[node] = (dt >> 2) & 3
+        if ic[node]:
+            cat_idx = int(tree.threshold_in_bin[node])
+            lo = tree.cat_boundaries_inner[cat_idx]
+            hi = tree.cat_boundaries_inner[cat_idx + 1]
+            words = tree.cat_threshold_inner[lo:hi][:8]
+            cb[node, :len(words)] = words
+        else:
+            thr[node] = int(tree.threshold_in_bin[node])
+        lc[node] = tree.left_child[node]
+        rc[node] = tree.right_child[node]
+    lv = np.zeros(max_leaves, np.float64)
+    lv[:tree.num_leaves] = tree.leaf_value[:tree.num_leaves]
+    as_j = jnp.asarray
+    return DeviceTree(as_j(sg), as_j(off), as_j(wid), as_j(db), as_j(nb),
+                      as_j(mi), as_j(thr), as_j(dl), as_j(ic), as_j(cb),
+                      as_j(lc), as_j(rc), as_j(lv, jnp.float32))
+
+
+@jax.jit
+def traverse(binned: jnp.ndarray, t: DeviceTree) -> jnp.ndarray:
+    """Leaf index per row of a (N, G) binned device matrix."""
+    n = binned.shape[0]
+    rows = jnp.arange(n, dtype=jnp.int32)
+
+    def decide(node):
+        grp = t.split_group[node]
+        slot = binned[rows, grp].astype(jnp.int32)
+        off = t.offset[node]
+        db = t.default_bin[node]
+        shift = jnp.where(db == 0, 1, 0)
+        in_range = (slot >= off) & (slot < off + t.width[node])
+        bin_ = jnp.where(in_range, slot - off + shift, db)
+        missing = t.missing[node]
+        is_default = bin_ == db
+        is_na = (missing == 2) & (bin_ == t.num_bin[node] - 1)
+        default_goes_left = jnp.where(missing == 1, t.default_left[node],
+                                      db <= t.threshold[node])
+        left_num = jnp.where(is_default, default_goes_left,
+                             jnp.where(is_na, t.default_left[node],
+                                       bin_ <= t.threshold[node]))
+        word = t.cat_bitset[node, jnp.clip(bin_ >> 5, 0, 7)]
+        left_cat = ((word >> (bin_ & 31).astype(jnp.uint32)) & 1) == 1
+        return jnp.where(t.is_cat[node], left_cat, left_num)
+
+    def cond(node):
+        return jnp.any(node >= 0)
+
+    def body(node):
+        act = node >= 0
+        cur = jnp.maximum(node, 0)
+        left = decide(cur)
+        nxt = jnp.where(left, t.left_child[cur], t.right_child[cur])
+        return jnp.where(act, nxt, node)
+
+    leaf_code = jax.lax.while_loop(cond, body,
+                                   jnp.zeros(n, jnp.int32)
+                                   if t.left_child.shape[0] > 0 else
+                                   jnp.full(n, -1, jnp.int32))
+    return ~leaf_code
+
+
+@jax.jit
+def add_tree_score(score, binned, t: DeviceTree, multiplier):
+    """score += multiplier * leaf_value[traverse(binned)]."""
+    leaf = traverse(binned, t)
+    return score + multiplier * t.leaf_value[leaf]
+
+
+@jax.jit
+def add_constant_score(score, value):
+    return score + value

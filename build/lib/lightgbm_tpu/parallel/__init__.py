@@ -1,0 +1,41 @@
+"""Distributed tree learners + collective verbs.
+
+Factory mirrors ``TreeLearner::CreateTreeLearner``
+(``src/treelearner/tree_learner.cpp:9-33``): ``tree_learner`` picks the
+implementation; the device dimension collapses because every learner here is
+TPU-resident.  ``num_machines`` (or an externally supplied mesh) sizes the
+one-axis worker mesh; with one machine every mode degrades to the serial
+learner — loudly, since learner choice is load-bearing in the reference
+(``CheckParamConflict`` forces ``is_parallel`` only for ``num_machines>1``,
+``src/io/config.cpp:180-280``).
+"""
+
+from ..tree.learner import SerialTreeLearner
+from ..utils.log import LightGBMError, log_warning
+
+
+def create_tree_learner(config, dataset, mesh=None):
+    kind = config.tree_learner
+    if kind not in ("serial", "feature", "data", "voting"):
+        raise LightGBMError(f"unknown tree_learner: {kind}")
+    if int(config.num_machines) <= 1 and mesh is None:
+        if kind != "serial":
+            log_warning(
+                f"tree_learner={kind} with num_machines=1: running the "
+                f"serial learner (set num_machines>1 or pass a mesh to "
+                f"enable the parallel learners)")
+        return SerialTreeLearner(config, dataset)
+    from .network import create_network
+    net = create_network(config, mesh)
+    if kind == "serial":
+        log_warning("num_machines>1 with tree_learner=serial: running "
+                    "single-device serial training")
+        return SerialTreeLearner(config, dataset)
+    if kind == "feature":
+        from .feature_parallel import FeatureParallelTreeLearner
+        return FeatureParallelTreeLearner(config, dataset, net)
+    if kind == "data":
+        from .data_parallel import DataParallelTreeLearner
+        return DataParallelTreeLearner(config, dataset, net)
+    from .voting_parallel import VotingParallelTreeLearner
+    return VotingParallelTreeLearner(config, dataset, net)

@@ -1,0 +1,168 @@
+"""Feature-parallel tree learner (reference
+``src/treelearner/feature_parallel_tree_learner.cpp``).
+
+Every worker holds ALL rows (data replicated); the feature *search* is
+sharded: the group axis of the binned matrix is sliced per device, each
+device builds histograms and scans thresholds only for its own feature
+groups, and the single communication per leaf is an allreduce-max of the
+13-float packed split record keyed lexicographically by (gain, -feature)
+— the TPU mapping of ``SyncUpGlobalBestSplit``
+(``parallel_tree_learner.h:183-207``, call at
+``feature_parallel_tree_learner.cpp:63``).  Because data is replicated, the
+partition then proceeds identically on every device with no split
+broadcast, exactly like the reference (``feature_parallel_tree_learner.cpp:
+31-74``).
+
+Shard layout: groups are assigned as contiguous slices of the group axis
+(the reference rebalances by bin count per tree,
+``feature_parallel_tree_learner.cpp:31-50``; contiguous slices keep XLA
+slicing static — group sizes are already balanced to <=256 bins by EFB).
+Per-device feature metadata lives in stacked (D, Fmax, ...) arrays sharded
+over the mesh axis, with -1 padding for devices owning fewer features.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ops.histogram import _histogram_scan, num_chunks_for
+from ..ops.split import (F_FEATURE, F_GAIN, FeatureMeta,
+                         find_best_split_impl)
+from ..tree.learner import SerialTreeLearner, _LeafInfo
+from .network import Network
+
+
+class FeatureParallelTreeLearner(SerialTreeLearner):
+    """Features sharded over the mesh axis; split records allreduced."""
+
+    def __init__(self, config, dataset, network: Network):
+        super().__init__(config, dataset)
+        self.net = network
+        d = network.num_machines
+        g = dataset.num_groups
+        self.g_loc = max(int(math.ceil(g / d)), 1)
+        g_pad = d * self.g_loc
+        cols = np.asarray(dataset.binned)
+        if g_pad > g:
+            cols = np.pad(cols, ((0, 0), (0, g_pad - g)))
+        # replicated: every worker holds all rows of all groups (the hist
+        # kernel slices its own columns); self.binned (serial) drives the
+        # replicated partition
+        self._binned_cols = network.replicate(jnp.asarray(cols))
+
+        f_group = np.asarray(dataset.f_group)
+        dev_feats = [np.nonzero((f_group >= w * self.g_loc)
+                                & (f_group < (w + 1) * self.g_loc))[0]
+                     for w in range(d)]
+        f_max = max(max((len(a) for a in dev_feats), default=1), 1)
+        metas = []
+        for w in range(d):
+            subset = np.full(f_max, -1, np.int64)
+            subset[:len(dev_feats[w])] = dev_feats[w]
+            metas.append(FeatureMeta.from_dataset(
+                dataset, subset, slot_base=w * self.g_loc * 256))
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *metas)
+        spec = lambda a: P(network.axis, *([None] * (a.ndim - 1)))
+        self._meta_sh = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, NamedSharding(network.mesh,
+                                                      spec(a))), stacked)
+        self._rep = P()
+        self._hist_fns: Dict = {}
+        self._fb_fn = None
+
+    # ------------------------------------------------------------------
+    def _hist_fn(self, m: int):
+        if m in self._hist_fns:
+            return self._hist_fns[m]
+        net, g_loc = self.net, self.g_loc
+        n_rows = int(self._binned_cols.shape[0])
+        num_chunks = num_chunks_for(m)
+
+        @jax.jit
+        @functools.partial(
+            jax.shard_map, mesh=net.mesh,
+            in_specs=(self._rep,) * 7,
+            out_specs=P(net.axis), check_vma=False)
+        def _hist(binned_cols, grad, hess, buffer, begin, start, count):
+            w = jax.lax.axis_index(net.axis)
+            cols = jax.lax.dynamic_slice(
+                binned_cols, (0, w * g_loc), (n_rows, g_loc))
+            win = jax.lax.dynamic_slice(buffer, (begin,), (m,))
+            pos = jnp.arange(m, dtype=jnp.int32)
+            valid = (pos >= start) & (pos < start + count)
+            idx = jnp.where(valid, win, 0)
+            bins = cols[idx]                               # (M, g_loc)
+            vf = valid.astype(jnp.float32)
+            gh = jnp.stack([grad[idx] * vf, hess[idx] * vf, vf], axis=1)
+            return _histogram_scan(bins, gh, num_chunks)   # (g_loc,256,3)
+
+        self._hist_fns[m] = _hist
+        return _hist
+
+    def _leaf_histogram(self, grad, hess, info: _LeafInfo):
+        b, m, start = self._window(info.begin, info.count)
+        fn = self._hist_fn(m)
+        i32 = lambda v: jnp.asarray(v, jnp.int32)
+        # out: (D*g_loc, 256, 3) sharded over groups
+        return fn(self._binned_cols, grad, hess, self.buffer, i32(b),
+                  i32(start), i32(info.count))
+
+    def _leaf_totals(self, hist) -> np.ndarray:
+        # group 0 is real on every dataset; its slots live on device 0
+        return np.asarray(hist[0].sum(axis=0), np.float64)
+
+    # ------------------------------------------------------------------
+    def _find_best(self, info: _LeafInfo, feature_mask):
+        if self._fb_fn is None:
+            net = self.net
+            nf = self.ctx.num_features
+            has_cat = self.ctx.has_categorical
+            meta_specs = jax.tree_util.tree_map(
+                lambda a: P(net.axis, *([None] * (a.ndim - 1))),
+                self._meta_sh)
+
+            @jax.jit
+            @functools.partial(
+                jax.shard_map, mesh=net.mesh,
+                in_specs=(P(net.axis), self._rep, self._rep, self._rep,
+                          meta_specs, self._rep),
+                out_specs=(self._rep, self._rep), check_vma=False)
+            def _fb(hist_sh, total, constraint, fmask, meta2, hp):
+                meta = jax.tree_util.tree_map(lambda a: a[0], meta2)
+                flat = hist_sh.reshape(-1, 3)
+                gid = meta.global_id
+                mask_l = jnp.where(
+                    gid >= 0, fmask[jnp.clip(gid, 0, nf - 1)], False)
+                packed, cat = find_best_split_impl(
+                    flat, total, constraint, mask_l, meta, hp, has_cat)
+                # SyncUpGlobalBestSplit: max gain, ties to the smaller
+                # global feature id (the serial argmax order)
+                gain = packed[F_GAIN]
+                fid = packed[F_FEATURE].astype(jnp.int32)
+                gmax = jax.lax.pmax(gain, net.axis)
+                is_max = gain == gmax
+                tid = jnp.where(is_max, fid, jnp.iinfo(jnp.int32).max)
+                tmin = jax.lax.pmin(tid, net.axis)
+                owner = is_max & (fid == tmin)
+                # select via where, NOT multiply: non-owner shards may carry
+                # inf outputs (0/0 leaf math on masked features) and
+                # inf * 0 = NaN would poison the psum
+                packed_g = jax.lax.psum(
+                    jnp.where(owner, packed, 0.0), net.axis)
+                cat_g = jax.lax.psum(
+                    jnp.where(owner, cat.astype(jnp.float32), 0.0), net.axis)
+                return packed_g, cat_g > 0.5
+
+            self._fb_fn = _fb
+        return self._fb_fn(info.hist,
+                           jnp.asarray(info.total, jnp.float32),
+                           jnp.asarray((info.cmin, info.cmax), jnp.float32),
+                           feature_mask, self._meta_sh, self.ctx.hyper)

@@ -1,0 +1,474 @@
+"""Single-source-of-truth parameter schema.
+
+The reference keeps every parameter as an annotated field of a C++ ``Config``
+struct (``include/LightGBM/config.h:27-873``) and generates the alias table,
+typed getters and the docs from those doc-comments via
+``helper/parameter_generator.py``.  We keep the same "one annotated schema
+generates parser + aliases + docs" design: every parameter is a ``Param``
+entry in ``PARAM_SCHEMA`` below; ``lightgbm_tpu.config.Config`` consumes the
+schema for alias resolution / type coercion / validation, and
+``python -m lightgbm_tpu.utils.gen_docs`` renders ``docs/Parameters.md``.
+
+No code is copied from the reference; parameter names, aliases, defaults and
+semantics follow the documented public LightGBM v2.2.2 parameter surface so
+that user configs written for the reference keep working.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class Param:
+    name: str
+    type: type
+    default: Any
+    aliases: tuple = ()
+    check: Optional[str] = None      # human-readable constraint, e.g. ">= 0.0"
+    desc: str = ""
+    section: str = "core"
+
+    def coerce(self, value):
+        """Coerce a raw (possibly string) value to this param's type."""
+        if self.type is bool:
+            if isinstance(value, str):
+                v = value.strip().lower()
+                if v in ("true", "1", "yes", "+"):
+                    return True
+                if v in ("false", "0", "no", "-"):
+                    return False
+                raise ValueError(f"cannot parse bool from {value!r} for {self.name}")
+            return bool(value)
+        if self.type is int:
+            if isinstance(value, str):
+                return int(float(value.strip()))
+            if isinstance(value, float) and value != int(value):
+                raise ValueError(f"{self.name} expects an int, got {value}")
+            return int(value)
+        if self.type is float:
+            if isinstance(value, str):
+                value = value.strip()
+            return float(value)
+        if self.type is str:
+            return str(value).strip() if isinstance(value, str) else str(value)
+        if self.type is list:
+            return _coerce_list(value)
+        return value
+
+
+def _coerce_list(value):
+    if value is None:
+        return []
+    if isinstance(value, (list, tuple)):
+        return list(value)
+    if isinstance(value, str):
+        value = value.strip()
+        if not value:
+            return []
+        return [v for v in value.replace(" ", ",").split(",") if v != ""]
+    return [value]
+
+
+def _p(name, type_, default, aliases=(), check=None, desc="", section="core"):
+    return Param(name, type_, default, tuple(aliases), check, desc, section)
+
+
+# ---------------------------------------------------------------------------
+# The schema.  Sections mirror the reference's config.h ordering:
+# core, learning control, IO, objective, metric, network, device.
+# ---------------------------------------------------------------------------
+
+PARAM_SCHEMA: Sequence[Param] = (
+    # -- core -------------------------------------------------------------
+    _p("config", str, "", ("config_file",),
+       desc="path to a key=value config file (CLI)", section="core"),
+    _p("task", str, "train", ("task_type",),
+       desc="train, predict (prediction), convert_model, refit (refit_tree)",
+       section="core"),
+    _p("objective", str, "regression",
+       ("objective_type", "app", "application"),
+       desc="regression, regression_l1, huber, fair, poisson, quantile, mape, "
+            "gamma, tweedie, binary, multiclass, multiclassova, cross_entropy, "
+            "cross_entropy_lambda, lambdarank",
+       section="core"),
+    _p("boosting", str, "gbdt", ("boosting_type", "boost"),
+       desc="gbdt, rf (random_forest), dart, goss", section="core"),
+    _p("data", str, "", ("train", "train_data", "train_data_file", "data_filename"),
+       desc="path of training data (CLI)", section="core"),
+    _p("valid", list, [], ("test", "valid_data", "valid_data_file",
+                           "test_data", "test_data_file", "valid_filenames"),
+       desc="paths of validation data, comma separated (CLI)", section="core"),
+    _p("num_iterations", int, 100,
+       ("num_iteration", "n_iter", "num_tree", "num_trees", "num_round",
+        "num_rounds", "num_boost_round", "n_estimators"),
+       check=">= 0", desc="number of boosting iterations", section="core"),
+    _p("learning_rate", float, 0.1, ("shrinkage_rate", "eta"),
+       check="> 0.0", desc="shrinkage rate", section="core"),
+    _p("num_leaves", int, 31, ("num_leaf", "max_leaves", "max_leaf"),
+       check="> 1", desc="max number of leaves in one tree", section="core"),
+    _p("tree_learner", str, "serial",
+       ("tree", "tree_type", "tree_learner_type"),
+       desc="serial, feature (feature_parallel), data (data_parallel), "
+            "voting (voting_parallel)", section="core"),
+    _p("num_threads", int, 0, ("num_thread", "nthread", "nthreads", "n_jobs"),
+       desc="number of host threads (0 = default)", section="core"),
+    _p("device_type", str, "tpu", ("device",),
+       desc="device for tree learning: tpu (default here), cpu. The reference's "
+            "cpu/gpu map to cpu/tpu in this framework", section="core"),
+    _p("seed", int, 0, ("random_seed", "random_state"),
+       desc="master seed; deterministically derives data/feature/bagging/drop "
+            "seeds like the reference", section="core"),
+
+    # -- learning control -------------------------------------------------
+    _p("max_depth", int, -1, (),
+       desc="limit tree depth, <= 0 means no limit", section="learning"),
+    _p("min_data_in_leaf", int, 20,
+       ("min_data_per_leaf", "min_data", "min_child_samples"),
+       check=">= 0", desc="minimal number of data in one leaf", section="learning"),
+    _p("min_sum_hessian_in_leaf", float, 1e-3,
+       ("min_sum_hessian_per_leaf", "min_sum_hessian", "min_hessian",
+        "min_child_weight"),
+       check=">= 0.0", desc="minimal sum of hessians in one leaf", section="learning"),
+    _p("bagging_fraction", float, 1.0,
+       ("sub_row", "subsample", "bagging"),
+       check="0.0 < x <= 1.0", desc="row subsample ratio (without replacement)",
+       section="learning"),
+    _p("pos_bagging_fraction", float, 1.0,
+       ("pos_sub_row", "pos_subsample", "pos_bagging"),
+       check="0.0 < x <= 1.0", desc="positive-class bagging fraction (binary)",
+       section="learning"),
+    _p("neg_bagging_fraction", float, 1.0,
+       ("neg_sub_row", "neg_subsample", "neg_bagging"),
+       check="0.0 < x <= 1.0", desc="negative-class bagging fraction (binary)",
+       section="learning"),
+    _p("bagging_freq", int, 0, ("subsample_freq",),
+       desc="bagging frequency; 0 disables bagging", section="learning"),
+    _p("bagging_seed", int, 3, ("bagging_fraction_seed",),
+       desc="bagging random seed", section="learning"),
+    _p("feature_fraction", float, 1.0,
+       ("sub_feature", "colsample_bytree"),
+       check="0.0 < x <= 1.0", desc="feature subsample ratio per tree",
+       section="learning"),
+    _p("feature_fraction_seed", int, 2, (),
+       desc="feature_fraction random seed", section="learning"),
+    _p("early_stopping_round", int, 0,
+       ("early_stopping_rounds", "early_stopping"),
+       desc="stop if one validation metric does not improve in this many rounds",
+       section="learning"),
+    _p("first_metric_only", bool, False, (),
+       desc="only use the first metric for early stopping", section="learning"),
+    _p("max_delta_step", float, 0.0, ("max_tree_output", "max_leaf_output"),
+       desc="limit the max output of tree leaves, <= 0 means no constraint",
+       section="learning"),
+    _p("lambda_l1", float, 0.0, ("reg_alpha",), check=">= 0.0",
+       desc="L1 regularization", section="learning"),
+    _p("lambda_l2", float, 0.0, ("reg_lambda", "lambda"), check=">= 0.0",
+       desc="L2 regularization", section="learning"),
+    _p("min_gain_to_split", float, 0.0, ("min_split_gain",), check=">= 0.0",
+       desc="minimal gain to perform split", section="learning"),
+    _p("drop_rate", float, 0.1, ("rate_drop",), check="0.0 <= x <= 1.0",
+       desc="dart: dropout rate", section="learning"),
+    _p("max_drop", int, 50, (),
+       desc="dart: max number of dropped trees per iteration, <=0 no limit",
+       section="learning"),
+    _p("skip_drop", float, 0.5, (), check="0.0 <= x <= 1.0",
+       desc="dart: probability of skipping drop", section="learning"),
+    _p("xgboost_dart_mode", bool, False, (),
+       desc="dart: use xgboost dart normalization", section="learning"),
+    _p("uniform_drop", bool, False, (),
+       desc="dart: uniform (vs weighted) drop", section="learning"),
+    _p("drop_seed", int, 4, (), desc="dart: drop random seed", section="learning"),
+    _p("top_rate", float, 0.2, (), check="0.0 <= x <= 1.0",
+       desc="goss: retain ratio of large-gradient data", section="learning"),
+    _p("other_rate", float, 0.1, (), check="0.0 <= x <= 1.0",
+       desc="goss: sample ratio of small-gradient data", section="learning"),
+    _p("min_data_per_group", int, 100, (), check="> 0",
+       desc="minimal data per categorical group", section="learning"),
+    _p("max_cat_threshold", int, 32, (), check="> 0",
+       desc="max number of categories on one side of a categorical split",
+       section="learning"),
+    _p("cat_l2", float, 10.0, (), check=">= 0.0",
+       desc="L2 regularization in categorical split", section="learning"),
+    _p("cat_smooth", float, 10.0, (), check=">= 0.0",
+       desc="smoothing of categorical bin statistics", section="learning"),
+    _p("max_cat_to_onehot", int, 4, (), check="> 0",
+       desc="use one-vs-other categorical split when #categories <= this",
+       section="learning"),
+    _p("top_k", int, 20, ("topk",), check="> 0",
+       desc="voting parallel: number of top features voted per worker",
+       section="learning"),
+    _p("monotone_constraints", list, [],
+       ("mc", "monotone_constraint"),
+       desc="per-feature monotone constraints: 1 increasing, -1 decreasing, 0 none",
+       section="learning"),
+    _p("feature_contri", list, [],
+       ("feature_contrib", "fc", "fp", "feature_penalty"),
+       desc="per-feature split-gain multipliers", section="learning"),
+    _p("forcedsplits_filename", str, "",
+       ("fs", "forced_splits_filename", "forced_splits_file", "forced_splits"),
+       desc="path to a JSON file of forced splits", section="learning"),
+    _p("refit_decay_rate", float, 0.9, (), check="0.0 <= x <= 1.0",
+       desc="decay rate of leaf values in refit task", section="learning"),
+    _p("verbosity", int, 1, ("verbose",),
+       desc="<0 fatal only, 0 error/warning, 1 info, >1 debug", section="io"),
+
+    # -- IO / dataset -----------------------------------------------------
+    _p("max_bin", int, 255, (), check="> 1",
+       desc="max number of bins for feature values", section="io"),
+    _p("min_data_in_bin", int, 3, (), check="> 0",
+       desc="minimal number of data inside one bin", section="io"),
+    _p("bin_construct_sample_cnt", int, 200000, ("subsample_for_bin",),
+       check="> 0", desc="number of sampled rows to construct bins", section="io"),
+    _p("histogram_pool_size", float, -1.0, ("hist_pool_size",),
+       desc="max cache size in MB for historical histograms; < 0 = no limit",
+       section="io"),
+    _p("data_random_seed", int, 1, ("data_seed",),
+       desc="random seed for sampling data rows for bin construction",
+       section="io"),
+    _p("output_model", str, "LightGBM_model.txt",
+       ("model_output", "model_out"),
+       desc="filename of output model (CLI)", section="io"),
+    _p("snapshot_freq", int, -1, ("save_period",),
+       desc="checkpoint frequency in iterations; <=0 disables", section="io"),
+    _p("input_model", str, "", ("model_input", "model_in"),
+       desc="filename of input model for continued train / predict", section="io"),
+    _p("output_result", str, "LightGBM_predict_result.txt",
+       ("predict_result", "prediction_result", "predict_name",
+        "prediction_name", "pred_name", "name_pred"),
+       desc="filename of prediction result (CLI predict task)", section="io"),
+    _p("initscore_filename", str, "",
+       ("init_score_filename", "init_score_file", "init_score",
+        "input_init_score"),
+       desc="path of initial-score file; '' means <data>.init if exists",
+       section="io"),
+    _p("valid_data_initscores", list, [],
+       ("valid_data_init_scores", "valid_init_score_file", "valid_init_score"),
+       desc="init-score files of validation data", section="io"),
+    _p("pre_partition", bool, False, ("is_pre_partition",),
+       desc="distributed: data is already partitioned across machines", section="io"),
+    _p("enable_bundle", bool, True, ("is_enable_bundle", "bundle"),
+       desc="enable exclusive feature bundling (EFB)", section="io"),
+    _p("max_conflict_rate", float, 0.0, (), check="0.0 <= x < 1.0",
+       desc="max conflict rate for EFB bundling", section="io"),
+    _p("is_enable_sparse", bool, True,
+       ("is_sparse", "enable_sparse", "sparse"),
+       desc="enable sparse optimization (host-side)", section="io"),
+    _p("sparse_threshold", float, 0.8, (), check="0.0 < x <= 1.0",
+       desc="zero-ratio threshold treating a feature group as sparse", section="io"),
+    _p("use_missing", bool, True, (),
+       desc="enable special handling of missing values", section="io"),
+    _p("zero_as_missing", bool, False, (),
+       desc="treat zero as missing (and unrecorded sparse entries)", section="io"),
+    _p("two_round", bool, False,
+       ("two_round_loading", "use_two_round_loading"),
+       desc="two-pass loading for data bigger than memory", section="io"),
+    _p("save_binary", bool, False, ("is_save_binary", "is_save_binary_file"),
+       desc="save dataset to binary cache file", section="io"),
+    _p("header", bool, False, ("has_header",),
+       desc="input data has a header line", section="io"),
+    _p("label_column", str, "", ("label",),
+       desc="label column: index or name: prefix", section="io"),
+    _p("weight_column", str, "", ("weight",),
+       desc="weight column: index or name: prefix", section="io"),
+    _p("group_column", str, "",
+       ("group", "group_id", "query_column", "query", "query_id"),
+       desc="query/group id column for ranking", section="io"),
+    _p("ignore_column", list, [],
+       ("ignore_feature", "blacklist"),
+       desc="columns to ignore", section="io"),
+    _p("categorical_feature", list, [],
+       ("cat_feature", "categorical_column", "cat_column"),
+       desc="categorical feature indices or name: list", section="io"),
+    _p("predict_raw_score", bool, False,
+       ("is_predict_raw_score", "predict_rawscore", "raw_score"),
+       desc="predict raw scores only", section="io"),
+    _p("predict_leaf_index", bool, False,
+       ("is_predict_leaf_index", "leaf_index"),
+       desc="predict leaf indices", section="io"),
+    _p("predict_contrib", bool, False,
+       ("is_predict_contrib", "contrib"),
+       desc="predict SHAP feature contributions", section="io"),
+    _p("num_iteration_predict", int, -1, (),
+       desc="number of iterations used in prediction, <=0 all", section="io"),
+    _p("pred_early_stop", bool, False, (),
+       desc="use early stopping in prediction", section="io"),
+    _p("pred_early_stop_freq", int, 10, (),
+       desc="frequency of checking prediction early stopping", section="io"),
+    _p("pred_early_stop_margin", float, 10.0, (),
+       desc="threshold margin for prediction early stopping", section="io"),
+    _p("convert_model_language", str, "", (),
+       desc="convert_model target language (cpp supported)", section="io"),
+    _p("convert_model", str, "gbdt_prediction.cpp",
+       ("convert_model_file",),
+       desc="output of convert_model task", section="io"),
+
+    # -- objective --------------------------------------------------------
+    _p("num_class", int, 1, ("num_classes",), check="> 0",
+       desc="number of classes for multiclass objectives", section="objective"),
+    _p("is_unbalance", bool, False, ("unbalance", "unbalanced_sets"),
+       desc="binary: auto-reweight unbalanced labels", section="objective"),
+    _p("scale_pos_weight", float, 1.0, (), check="> 0.0",
+       desc="binary: weight of positive labels", section="objective"),
+    _p("sigmoid", float, 1.0, (), check="> 0.0",
+       desc="sigmoid steepness for binary/lambdarank", section="objective"),
+    _p("boost_from_average", bool, True, (),
+       desc="start from the average label instead of 0", section="objective"),
+    _p("reg_sqrt", bool, False, (),
+       desc="regression on sqrt(label) (undone at prediction)", section="objective"),
+    _p("alpha", float, 0.9, (), check="> 0.0",
+       desc="parameter of huber/quantile loss", section="objective"),
+    _p("fair_c", float, 1.0, (), check="> 0.0",
+       desc="parameter of fair loss", section="objective"),
+    _p("poisson_max_delta_step", float, 0.7, (), check="> 0.0",
+       desc="parameter of poisson hessian safeguard", section="objective"),
+    _p("tweedie_variance_power", float, 1.5, (), check="1.0 <= x < 2.0",
+       desc="tweedie variance power", section="objective"),
+    _p("max_position", int, 20, (), check="> 0",
+       desc="lambdarank NDCG optimization position cutoff", section="objective"),
+    _p("label_gain", list, [], (),
+       desc="lambdarank gain per label level, default 2^l - 1", section="objective"),
+
+    # -- metric -----------------------------------------------------------
+    _p("metric", list, [],
+       ("metrics", "metric_types"),
+       desc="metric names, '' uses objective default, 'None' disables",
+       section="metric"),
+    _p("metric_freq", int, 1, ("output_freq",), check="> 0",
+       desc="metric output frequency", section="metric"),
+    _p("is_provide_training_metric", bool, False,
+       ("training_metric", "is_training_metric", "train_metric"),
+       desc="output metrics on training data", section="metric"),
+    _p("eval_at", list, [1, 2, 3, 4, 5],
+       ("ndcg_eval_at", "ndcg_at", "map_eval_at", "map_at"),
+       desc="evaluation positions for NDCG/MAP", section="metric"),
+
+    # -- network ----------------------------------------------------------
+    _p("num_machines", int, 1, ("num_machine",), check="> 0",
+       desc="number of workers in the mesh axis (distributed)", section="network"),
+    _p("local_listen_port", int, 12400, ("local_port",),
+       desc="accepted for reference compatibility; unused on TPU (ICI mesh)",
+       section="network"),
+    _p("time_out", int, 120, (), desc="socket timeout in minutes (compat; unused)",
+       section="network"),
+    _p("machine_list_filename", str, "",
+       ("machine_list_file", "machine_list", "mlist"),
+       desc="machine list file (compat; unused on TPU)", section="network"),
+    _p("machines", str, "", ("workers", "nodes"),
+       desc="machine list (compat; unused on TPU)", section="network"),
+
+    # -- device -----------------------------------------------------------
+    _p("gpu_platform_id", int, -1, (), desc="compat; ignored", section="device"),
+    _p("gpu_device_id", int, -1, (), desc="compat; ignored", section="device"),
+    _p("gpu_use_dp", bool, False, (),
+       desc="use float64 histogram accumulation on device (maps the reference's "
+            "gpu_use_dp); default float32", section="device"),
+    _p("tpu_double_precision", bool, False, (),
+       desc="alias-level switch for float64 accumulation on TPU", section="device"),
+    _p("tpu_rows_per_block", int, 0, (),
+       desc="rows per Pallas histogram grid block; 0 = auto", section="device"),
+    _p("device_growth", str, "auto", ("tpu_device_growth",),
+       check="auto/on/off",
+       desc="fully on-device wave-synchronized tree growth (one dispatch "
+            "per boosting iteration, no per-split host sync). auto = on "
+            "for TPU backends when the config is eligible (serial learner, "
+            "single model, numerical features, no bagging/monotone/forced "
+            "splits); off = always use the host-driven learner",
+       section="device"),
+    _p("deterministic", bool, True, (),
+       desc="bit-deterministic device reductions where possible", section="device"),
+)
+
+
+PARAM_BY_NAME = {p.name: p for p in PARAM_SCHEMA}
+
+# alias -> canonical name (includes the canonical names themselves)
+PARAM_ALIASES = {}
+for _param in PARAM_SCHEMA:
+    PARAM_ALIASES[_param.name] = _param.name
+    for _a in _param.aliases:
+        # first writer wins, like the reference alias table
+        PARAM_ALIASES.setdefault(_a, _param.name)
+
+# objective aliases resolved at value level (Config.set handles these)
+OBJECTIVE_ALIASES = {
+    "regression": "regression",
+    "regression_l2": "regression",
+    "l2": "regression",
+    "mean_squared_error": "regression",
+    "mse": "regression",
+    "l2_root": "regression",
+    "root_mean_squared_error": "regression",
+    "rmse": "regression",
+    "regression_l1": "regression_l1",
+    "l1": "regression_l1",
+    "mean_absolute_error": "regression_l1",
+    "mae": "regression_l1",
+    "huber": "huber",
+    "fair": "fair",
+    "poisson": "poisson",
+    "quantile": "quantile",
+    "mape": "mape",
+    "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma",
+    "tweedie": "tweedie",
+    "binary": "binary",
+    "multiclass": "multiclass",
+    "softmax": "multiclass",
+    "multiclassova": "multiclassova",
+    "multiclass_ova": "multiclassova",
+    "ova": "multiclassova",
+    "ovr": "multiclassova",
+    "cross_entropy": "cross_entropy",
+    "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda",
+    "xentlambda": "cross_entropy_lambda",
+    "lambdarank": "lambdarank",
+    "none": "none",
+    "null": "none",
+    "custom": "none",
+    "na": "none",
+}
+
+METRIC_ALIASES = {
+    "l1": "l1", "mean_absolute_error": "l1", "mae": "l1", "regression_l1": "l1",
+    "l2": "l2", "mean_squared_error": "l2", "mse": "l2", "regression_l2": "l2",
+    "regression": "l2",
+    "l2_root": "rmse", "root_mean_squared_error": "rmse", "rmse": "rmse",
+    "quantile": "quantile",
+    "mape": "mape", "mean_absolute_percentage_error": "mape",
+    "huber": "huber",
+    "fair": "fair",
+    "poisson": "poisson",
+    "gamma": "gamma",
+    "gamma_deviance": "gamma_deviance", "gamma-deviance": "gamma_deviance",
+    "tweedie": "tweedie",
+    "ndcg": "ndcg", "lambdarank": "ndcg",
+    "map": "map", "mean_average_precision": "map",
+    "auc": "auc",
+    "binary_logloss": "binary_logloss", "binary": "binary_logloss",
+    "binary_error": "binary_error",
+    "multi_logloss": "multi_logloss", "multiclass": "multi_logloss",
+    "softmax": "multi_logloss", "multiclassova": "multi_logloss",
+    "multiclass_ova": "multi_logloss", "ova": "multi_logloss", "ovr": "multi_logloss",
+    "multi_error": "multi_error",
+    "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda", "xentlambda": "cross_entropy_lambda",
+    "kullback_leibler": "kldiv", "kldiv": "kldiv",
+    "": "", "none": "none", "null": "none", "na": "none", "custom": "none",
+}
+
+BOOSTING_ALIASES = {
+    "gbdt": "gbdt", "gbrt": "gbdt",
+    "dart": "dart",
+    "goss": "goss",
+    "rf": "rf", "random_forest": "rf",
+}
+
+TREE_LEARNER_ALIASES = {
+    "serial": "serial",
+    "feature": "feature", "feature_parallel": "feature",
+    "data": "data", "data_parallel": "data",
+    "voting": "voting", "voting_parallel": "voting",
+}

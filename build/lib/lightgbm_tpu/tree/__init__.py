@@ -1,0 +1,2 @@
+from .tree import Tree  # noqa: F401
+from .learner import SerialTreeLearner  # noqa: F401

@@ -1,0 +1,17 @@
+from .log import (
+    LightGBMError,
+    Timer,
+    log_debug,
+    log_fatal,
+    log_info,
+    log_warning,
+    register_log_callback,
+    set_verbosity,
+)
+from .random import derive_seeds, make_rng, sample_k
+
+__all__ = [
+    "LightGBMError", "Timer", "log_debug", "log_fatal", "log_info",
+    "log_warning", "register_log_callback", "set_verbosity",
+    "derive_seeds", "make_rng", "sample_k",
+]

@@ -1,0 +1,95 @@
+"""Render ``docs/Parameters.md`` from the annotated parameter schema.
+
+The reference generates ``docs/Parameters.rst`` and the alias table from
+the ``Config`` struct's doc-comments via ``helper/parameter_generator.py``
+(SURVEY §5 calls the one-schema-generates-everything property
+load-bearing).  Here the single source of truth is
+``lightgbm_tpu.params.PARAM_SCHEMA``; this module renders the markdown
+doc, and ``tests/test_api.py`` asserts the committed file is not stale.
+
+Usage: ``python -m lightgbm_tpu.utils.gen_docs [output_path]``
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ..params import PARAM_SCHEMA
+
+_SECTION_TITLES = {
+    "core": "Core Parameters",
+    "learning": "Learning Control Parameters",
+    "io": "IO Parameters",
+    "objective": "Objective Parameters",
+    "metric": "Metric Parameters",
+    "network": "Network Parameters",
+    "device": "Device Parameters",
+}
+_SECTION_ORDER = ("core", "learning", "io", "objective", "metric",
+                  "network", "device")
+
+
+def _fmt_default(p) -> str:
+    v = p.default
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, str):
+        return f'"{v}"' if v else '""'
+    if isinstance(v, (list, tuple)):
+        return '""' if not v else ",".join(str(x) for x in v)
+    return str(v)
+
+
+def _fmt_type(p) -> str:
+    t = p.type
+    if t is bool:
+        return "bool"
+    if t is int:
+        return "int"
+    if t is float:
+        return "double"
+    if t is list:
+        return "multi-value string"
+    return "string"
+
+
+def render() -> str:
+    out = ["# Parameters", "",
+           "Generated from `lightgbm_tpu/params.py` "
+           "(`python -m lightgbm_tpu.utils.gen_docs`). "
+           "Do not edit by hand — the schema is the single source of "
+           "truth for the parser, the alias table, and this document, "
+           "mirroring the reference's `helper/parameter_generator.py` "
+           "flow over `include/LightGBM/config.h`.", ""]
+    for section in _SECTION_ORDER:
+        params = [p for p in PARAM_SCHEMA if p.section == section]
+        if not params:
+            continue
+        out.append(f"## {_SECTION_TITLES[section]}")
+        out.append("")
+        for p in params:
+            head = (f"- `{p.name}` : {_fmt_type(p)}, "
+                    f"default = `{_fmt_default(p)}`")
+            if p.check:
+                head += f", constraint: `{p.check}`"
+            out.append(head)
+            for alias in p.aliases:
+                out.append(f"  - alias: `{alias}`")
+            if p.desc:
+                out.append(f"  - {p.desc}")
+        out.append("")
+    return "\n".join(out) + "\n"
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    path = argv[0] if argv else "docs/Parameters.md"
+    text = render()
+    with open(path, "w") as fh:
+        fh.write(text)
+    print(f"wrote {path} ({text.count(chr(10))} lines)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

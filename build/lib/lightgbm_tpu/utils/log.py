@@ -1,0 +1,114 @@
+"""Logging with LightGBM-style levels (reference: utils/log.h:1-105).
+
+Levels: Fatal < Warning < Info < Debug.  ``log_fatal`` raises, matching the
+reference where ``Log::Fatal`` throws ``std::runtime_error``.  Verbosity is
+controlled globally via :func:`set_verbosity` (config param ``verbosity``:
+<0 fatal only, 0 warning, 1 info, >=2 debug).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Callable, Optional
+
+_FATAL, _WARNING, _INFO, _DEBUG = -1, 0, 1, 2
+_verbosity = _INFO
+_callback: Optional[Callable[[str], None]] = None
+
+
+class LightGBMError(RuntimeError):
+    """Error raised by the framework (reference: Log::Fatal throw)."""
+
+
+def set_verbosity(level: int) -> None:
+    global _verbosity
+    _verbosity = level
+
+
+def get_verbosity() -> int:
+    return _verbosity
+
+
+def register_log_callback(cb: Optional[Callable[[str], None]]) -> None:
+    """Redirect log output (reference: R callback redirection)."""
+    global _callback
+    _callback = cb
+
+
+def _emit(msg: str) -> None:
+    if _callback is not None:
+        _callback(msg + "\n")
+    else:
+        sys.stderr.write(msg + "\n")
+        sys.stderr.flush()
+
+
+def log_debug(msg: str) -> None:
+    if _verbosity >= _DEBUG:
+        _emit(f"[LightGBM-TPU] [Debug] {msg}")
+
+
+def log_info(msg: str) -> None:
+    if _verbosity >= _INFO:
+        _emit(f"[LightGBM-TPU] [Info] {msg}")
+
+
+def log_warning(msg: str) -> None:
+    if _verbosity >= _WARNING:
+        _emit(f"[LightGBM-TPU] [Warning] {msg}")
+
+
+def log_fatal(msg: str) -> None:
+    raise LightGBMError(msg)
+
+
+class Timer:
+    """Accumulating per-phase wall-clock timer.
+
+    First-class version of the reference's compile-time TIMETAG counters
+    (``serial_tree_learner.cpp:14-41``): ``timer.start("hist")`` /
+    ``timer.stop("hist")`` accumulate, ``timer.report()`` pretty-prints.
+
+    With ``sync=True`` the :meth:`stop_sync` variant blocks on the device
+    value before stopping the clock, so phase times attribute device work to
+    the phase that dispatched it (JAX dispatch is async; without syncing,
+    device time piles up at the next host fetch).  Leave ``sync=False`` in
+    production — blocking per phase serialises the device pipeline.
+    """
+
+    def __init__(self):
+        self.acc = {}
+        self.counts = {}
+        self._t0 = {}
+        self.sync = False
+
+    def start(self, tag: str) -> None:
+        self._t0[tag] = time.perf_counter()
+
+    def stop(self, tag: str) -> None:
+        t0 = self._t0.pop(tag, None)
+        if t0 is not None:
+            self.acc[tag] = self.acc.get(tag, 0.0) + time.perf_counter() - t0
+            self.counts[tag] = self.counts.get(tag, 0) + 1
+
+    def stop_sync(self, tag: str, value=None):
+        """Stop after blocking on ``value`` when ``sync`` profiling is on."""
+        if self.sync and value is not None:
+            import jax
+            jax.block_until_ready(value)
+        self.stop(tag)
+        return value
+
+    def report(self) -> str:
+        return ", ".join(f"{k}={v:.3f}s" for k, v in sorted(self.acc.items()))
+
+    def reset(self) -> None:
+        self.acc.clear()
+        self.counts.clear()
+        self._t0.clear()
+
+
+#: process-global training-phase timer (wired through the tree learner and
+#: the boosting loop; ``bench.py`` reads and resets it)
+TRAIN_TIMER = Timer()

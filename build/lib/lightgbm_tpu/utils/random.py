@@ -1,0 +1,40 @@
+"""Deterministic host-side RNG helpers.
+
+The reference uses a tiny xorshift-style ``Random`` (utils/random.h) for
+bagging / feature-fraction / bundling so results are reproducible per seed.
+We use numpy Generators seeded deterministically instead — same guarantees
+(deterministic per seed), idiomatic host code.  Device-side sampling (GOSS,
+DART masks, bagging masks when fused) uses jax.random with keys derived from
+the same master seed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def make_rng(seed: int) -> np.random.Generator:
+    return np.random.default_rng(np.uint64(seed & 0xFFFFFFFFFFFFFFFF))
+
+
+def sample_k(rng: np.random.Generator, n: int, k: int) -> np.ndarray:
+    """Sample k distinct indices from range(n), sorted (reference Random::Sample)."""
+    k = max(0, min(k, n))
+    if k == 0:
+        return np.empty(0, dtype=np.int32)
+    idx = rng.choice(n, size=k, replace=False)
+    idx.sort()
+    return idx.astype(np.int32)
+
+
+def derive_seeds(master_seed: int):
+    """Derive sub-seeds for each consumer from one master seed.
+
+    Mirrors the reference Config behaviour where ``seed`` overrides
+    data_random_seed / feature_fraction_seed / bagging_seed / drop_seed
+    deterministically.
+    """
+    ss = np.random.SeedSequence(master_seed)
+    children = ss.spawn(5)
+    names = ("data", "feature_fraction", "bagging", "drop", "objective")
+    return {n: int(c.generate_state(1)[0]) for n, c in zip(names, children)}

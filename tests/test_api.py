@@ -234,3 +234,17 @@ def test_cli_train_and_predict(tmp_path, bin_data):
     preds = np.loadtxt(out_file)
     assert preds.shape[0] == len(yt)
     assert ((preds > 0.5) == (yt > 0)).mean() > 0.7
+
+
+# ---------------------------------------------------------------------------
+def test_parameters_doc_not_stale():
+    """docs/Parameters.md is generated from the params schema; a schema
+    change without regenerating the doc must fail (the reference keeps
+    docs/Parameters.rst in lockstep via helper/parameter_generator.py)."""
+    import pathlib
+    from lightgbm_tpu.utils.gen_docs import render
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    committed = (repo / "docs" / "Parameters.md").read_text()
+    assert committed == render(), (
+        "docs/Parameters.md is stale; regenerate with "
+        "`python -m lightgbm_tpu.utils.gen_docs docs/Parameters.md`")
