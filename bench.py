@@ -37,6 +37,7 @@ import numpy as np
 
 BASELINE_CPU_S = 238.5   # docs/Experiments.rst:106 (500 iters, 2x E5-2670v3)
 BASELINE_GPU_S = 80.0    # implied ~3x GPU speedup, docs/GPU-Performance.rst
+BASELINE_MSLR_S = 215.32  # docs/Experiments.rst:109-110 (MS LTR, 500 iters)
 
 
 def synth_higgs(rows: int, cols: int = 28, seed: int = 7):
@@ -56,37 +57,7 @@ def synth_higgs(rows: int, cols: int = 28, seed: int = 7):
     return x, y
 
 
-def main() -> int:
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--rows", type=int,
-                    default=int(os.environ.get("BENCH_ROWS", 10_500_000)))
-    ap.add_argument("--iters", type=int,
-                    default=int(os.environ.get("BENCH_ITERS", 500)))
-    ap.add_argument("--num-leaves", type=int, default=255)
-    ap.add_argument("--max-bin", type=int,
-                    default=int(os.environ.get("BENCH_MAX_BIN", 63)),
-                    help="63 matches the reference GPU learner's own "
-                         "benchmark setting (docs/GPU-Performance.rst); "
-                         "255 matches the CPU run")
-    ap.add_argument("--learning-rate", type=float, default=0.1)
-    ap.add_argument("--quick", action="store_true",
-                    help="1M rows, 50 iterations")
-    ap.add_argument("--profile", action="store_true",
-                    default=bool(int(os.environ.get("BENCH_PROFILE", "0"))),
-                    help="block per phase for honest phase attribution "
-                         "(slows the run; don't use for the headline number)")
-    ap.add_argument("--eval-rows", type=int, default=500_000,
-                    help="held-out rows for AUC (0 disables)")
-    ap.add_argument("--engine", choices=["auto", "device", "host"],
-                    default="device",
-                    help="device = on-device wave grower (one dispatch per "
-                         "iteration); host = host-driven learner; auto = "
-                         "device on TPU")
-    args = ap.parse_args()
-    if args.quick:
-        args.rows = min(args.rows, 1_000_000)
-        args.iters = min(args.iters, 50)
-
+def run_higgs(args) -> dict:
     import jax
     from lightgbm_tpu.boosting import create_boosting
     from lightgbm_tpu.config import Config
@@ -172,6 +143,17 @@ def main() -> int:
 
     iters_run = bst.num_iterations()
     phases = {k: round(v, 3) for k, v in sorted(TRAIN_TIMER.acc.items())}
+    waves_per_tree = None
+    if getattr(bst, "_wave_handles", None):
+        tot = sum(int(np.asarray(h)) for h in bst._wave_handles)
+        waves_per_tree = round(tot / len(bst._wave_handles), 2)
+    if args.profile and getattr(bst, "_grower", None) is not None:
+        # per-phase ms for ONE wave's components, separately jitted and
+        # synced (the production while_loop hides phases from the host)
+        g, h = bst.objective.get_gradients(bst.train_score)
+        if g.ndim > 1:
+            g, h = g[0], h[0]
+        phases["device_wave_ms"] = bst._grower.profile_phases(g, h)
     result = {
         "metric": f"higgs_synth_{args.rows}x28_{args.iters}iter_wallclock",
         "value": round(train_s, 3),
@@ -187,6 +169,7 @@ def main() -> int:
         "time_per_tree_ms": round(1000.0 * per_iter, 2),
         "rows_per_sec": round(args.rows * iters_run / train_s, 0),
         "auc": round(auc, 6) if auc is not None else None,
+        "waves_per_tree": waves_per_tree,
         "backend": backend,
         "device": dev,
         "phases_s": phases,
@@ -195,6 +178,181 @@ def main() -> int:
         "bin_s": round(t_bin, 2),
         "warmup_compile_s": round(t_warm, 2),
     }
+    return result
+
+
+def synth_mslr(rows: int, cols: int = 136, n_queries: int = 6000,
+               seed: int = 7):
+    """MSLR-WEB10K-shaped synthetic LTR data: ~723k docs over ~6k queries
+    with lognormal query sizes (~120 docs avg), 136 features, and 5-level
+    relevance whose signal is a noisy nonlinear function of the features
+    (so lambdarank has real structure to learn).  Shapes follow
+    BASELINE.md "MS LTR" (docs/Experiments.rst:109,142-143)."""
+    wrng = np.random.default_rng(20260731)
+    w1 = wrng.standard_normal(cols).astype(np.float32) / np.sqrt(cols)
+    w2 = wrng.standard_normal(cols).astype(np.float32) / np.sqrt(cols)
+    rng = np.random.default_rng(seed)
+    sizes = np.clip(rng.lognormal(4.45, 0.7, n_queries).astype(np.int64),
+                    5, 1000)
+    scale = rows / sizes.sum()
+    sizes = np.maximum((sizes * scale).astype(np.int64), 2)
+    total = int(sizes.sum())
+    x = rng.standard_normal((total, cols), dtype=np.float32)
+    # per-query quality offset so ranking within query is what matters
+    qoff = np.repeat(rng.standard_normal(n_queries, dtype=np.float32),
+                     sizes)
+    util = (x @ w1) + 0.7 * np.abs(x @ w2) + 0.8 * qoff         + 0.9 * rng.standard_normal(total, dtype=np.float32)
+    # 5 relevance levels from global utility quantiles (skewed like MSLR)
+    qs = np.quantile(util, [0.55, 0.75, 0.90, 0.97])
+    y = np.digitize(util, qs).astype(np.float32)
+    return x, y, sizes
+
+
+def _ndcg_at_k(scores, labels, qb, k=10):
+    out = []
+    lg = np.asarray([(1 << min(int(v), 30)) - 1 for v in range(32)],
+                    np.float64)
+    disc = 1.0 / np.log2(np.arange(2, k + 2))
+    for i in range(len(qb) - 1):
+        lo, hi = qb[i], qb[i + 1]
+        lab = labels[lo:hi]
+        if lab.max() <= 0:
+            continue
+        order = np.argsort(-scores[lo:hi], kind="stable")[:k]
+        dcg = float((lg[lab[order].astype(np.int64)] * disc[:len(order)])
+                    .sum())
+        ideal = np.sort(lab)[::-1][:k]
+        idcg = float((lg[ideal.astype(np.int64)] * disc[:len(ideal)])
+                     .sum())
+        out.append(dcg / idcg)
+    return float(np.mean(out))
+
+
+def run_mslr(args) -> dict:
+    import jax
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data.dataset import BinnedDataset
+
+    rows = 723_412 if not args.quick else 100_000
+    iters = args.iters
+    t0 = time.perf_counter()
+    x, y, sizes = synth_mslr(rows)
+    xt, yt, sizes_t = synth_mslr(120_000 if not args.quick else 30_000,
+                                 n_queries=1000, seed=1234)
+    t_gen = time.perf_counter() - t0
+
+    cfg = Config({
+        "objective": "lambdarank", "metric": "ndcg",
+        "num_leaves": args.num_leaves, "max_bin": args.max_bin,
+        "learning_rate": args.learning_rate,
+        "min_data_in_leaf": 20, "min_sum_hessian_in_leaf": 1e-3,
+        "verbosity": 0,
+        "device_growth": {"device": "on", "host": "off",
+                          "auto": "auto"}[args.engine],
+    })
+    t0 = time.perf_counter()
+    ds = BinnedDataset.construct_from_matrix(x, cfg)
+    ds.metadata.set_label(y)
+    ds.metadata.set_query(sizes)
+    t_bin = time.perf_counter() - t0
+
+    bst = create_boosting(cfg)
+    t0 = time.perf_counter()
+    bst.init_train(ds)
+    warm = min(2, iters)
+    for _ in range(warm):
+        bst.train_one_iter()
+    jax.block_until_ready(bst.train_score)
+    t_warm = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(iters - warm):
+        if bst.train_one_iter():
+            break
+    jax.block_until_ready(bst.train_score)
+    timed_s = time.perf_counter() - t0
+    iters_timed = bst.num_iterations() - warm
+    per_iter = timed_s / max(iters_timed, 1)
+    train_s = per_iter * bst.num_iterations()
+
+    # NDCG@10 on held-out queries via the device traversal
+    from lightgbm_tpu.ops.traverse import add_tree_score, device_tree
+    import jax.numpy as jnp
+    bst._flush_pending()
+    vds = BinnedDataset.construct_from_matrix(xt, cfg, reference=ds)
+    binned_d = jnp.asarray(vds.binned)
+    score = jnp.zeros(xt.shape[0], jnp.float32)
+    for tree in bst.models:
+        if tree.num_leaves > 1:
+            score = add_tree_score(
+                score, binned_d, device_tree(tree, ds, cfg.num_leaves),
+                1.0)
+    raw = np.asarray(score, np.float64)
+    qb = np.concatenate([[0], np.cumsum(sizes_t)])
+    ndcg10 = _ndcg_at_k(raw, yt, qb, 10)
+
+    return {
+        "metric": f"mslr_synth_{rows}x136_{iters}iter_wallclock",
+        "value": round(train_s, 3),
+        "unit": "s",
+        "vs_baseline": round(train_s / BASELINE_MSLR_S, 4),
+        "baseline_cpu_s": BASELINE_MSLR_S,
+        "rows": rows,
+        "iters": bst.num_iterations(),
+        "time_per_tree_ms": round(1000.0 * per_iter, 2),
+        "ndcg10": round(ndcg10, 6),
+        "ndcg10_ref": 0.527371,
+        "gen_s": round(t_gen, 2),
+        "bin_s": round(t_bin, 2),
+        "warmup_compile_s": round(t_warm, 2),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int,
+                    default=int(os.environ.get("BENCH_ROWS", 10_500_000)))
+    ap.add_argument("--iters", type=int,
+                    default=int(os.environ.get("BENCH_ITERS", 500)))
+    ap.add_argument("--num-leaves", type=int, default=255)
+    ap.add_argument("--max-bin", type=int,
+                    default=int(os.environ.get("BENCH_MAX_BIN", 63)),
+                    help="63 matches the reference GPU learner's own "
+                         "benchmark setting (docs/GPU-Performance.rst); "
+                         "255 matches the CPU run")
+    ap.add_argument("--learning-rate", type=float, default=0.1)
+    ap.add_argument("--quick", action="store_true",
+                    help="1M rows, 50 iterations")
+    ap.add_argument("--profile", action="store_true",
+                    default=bool(int(os.environ.get("BENCH_PROFILE", "0"))),
+                    help="block per phase for honest phase attribution "
+                         "(slows the run; don't use for the headline number)")
+    ap.add_argument("--eval-rows", type=int, default=500_000,
+                    help="held-out rows for AUC (0 disables)")
+    ap.add_argument("--engine", choices=["auto", "device", "host"],
+                    default="device",
+                    help="device = on-device wave grower (one dispatch per "
+                         "iteration); host = host-driven learner; auto = "
+                         "device on TPU")
+    ap.add_argument("--suite", choices=["all", "higgs", "mslr"],
+                    default=os.environ.get("BENCH_SUITE", "all"),
+                    help="all = HIGGS headline + MSLR lambdarank "
+                         "(both north stars, BASELINE.md)")
+    args = ap.parse_args()
+    if args.quick:
+        args.rows = min(args.rows, 1_000_000)
+        args.iters = min(args.iters, 50)
+
+    if args.suite == "mslr":
+        result = run_mslr(args)
+    else:
+        result = run_higgs(args)
+        if args.suite == "all":
+            try:
+                result["mslr"] = run_mslr(args)
+            except Exception as e:   # noqa: BLE001 — keep the headline
+                result["mslr"] = {"error": str(e)}
     print(json.dumps(result))
     return 0
 

@@ -120,6 +120,7 @@ class GBDT:
         self._grower = None
         self._device_stop = False
         self._nl_queue: List = []   # in-flight num_leaves handles (lagged)
+        self._wave_handles: List = []  # per-iter wave counts (device scalars)
 
     # ------------------------------------------------------------------
     def init_train(self, train_set: BinnedDataset, objective=None):
@@ -337,10 +338,12 @@ class GBDT:
         if grad.ndim > 1:
             grad, hess = grad[0], hess[0]
         mask = self.learner._feature_mask()
-        score, rec_i, rec_f, nl, root_val = self._grower.grow_one_iter(
-            self.train_score[0], grad, hess, mask,
-            self.shrinkage_rate * self._tree_multiplier())
+        score, rec_i, rec_f, nl, root_val, waves = \
+            self._grower.grow_one_iter(
+                self.train_score[0], grad, hess, mask,
+                self.shrinkage_rate * self._tree_multiplier())
         self.train_score = score[None, :]
+        self._wave_handles.append(waves)   # async scalars; bench sums them
         self.models.append(_PendingTree(
             rec_i, rec_f, nl, root_val,
             self.shrinkage_rate * self._tree_multiplier(), init_score))

@@ -1,0 +1,554 @@
+"""``LGBM_*`` C-API compatibility shim.
+
+The reference's compatibility contract is ``src/c_api.cpp`` /
+``include/LightGBM/c_api.h:50-234,799-815``: opaque dataset/booster
+handles, int return codes (0 ok, -1 failure + ``LGBM_GetLastError``),
+caller-allocated output buffers.  The fork's cache-admission harness
+consumes exactly this surface (``src/test.cpp:243-298``:
+DatasetCreateFromCSR / DatasetSetField / BoosterCreate /
+BoosterUpdateOneIter / BoosterPredictForCSR).
+
+This module reproduces that surface Python-level so C-API-shaped client
+code ports mechanically:
+
+* handles are opaque ints managed by an internal registry — ``Free``
+  really invalidates them, double-free raises through the error code;
+* out-parameters are ``Ref`` cells (the ``ctypes.byref`` analog);
+* array arguments are numpy arrays whose dtype must match the declared
+  ``C_API_DTYPE_*`` constant, like the C layer's type switch;
+* caller-allocated result buffers (``out_result``) are written in place.
+
+Functions intentionally keep the reference's argument order, including
+the ``parameters`` string argument, so a port is a transliteration.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional
+
+import numpy as np
+
+from .boosting import create_boosting
+from .boosting.gbdt import GBDT
+from .config import Config
+from .data.dataset import BinnedDataset, Metadata
+from .utils.log import LightGBMError
+
+C_API_DTYPE_FLOAT32 = 0
+C_API_DTYPE_FLOAT64 = 1
+C_API_DTYPE_INT32 = 2
+C_API_DTYPE_INT64 = 3
+
+C_API_PREDICT_NORMAL = 0
+C_API_PREDICT_RAW_SCORE = 1
+C_API_PREDICT_LEAF_INDEX = 2
+C_API_PREDICT_CONTRIB = 3
+
+_DTYPE_MAP = {
+    C_API_DTYPE_FLOAT32: np.float32,
+    C_API_DTYPE_FLOAT64: np.float64,
+    C_API_DTYPE_INT32: np.int32,
+    C_API_DTYPE_INT64: np.int64,
+}
+
+
+class Ref:
+    """Out-parameter cell — the ``ctypes.byref(x)`` analog."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value=None):
+        self.value = value
+
+
+_last_error = ""
+
+
+def LGBM_GetLastError() -> str:
+    return _last_error
+
+
+def _api(fn):
+    """C return-code convention: 0 ok, -1 failure + stored message."""
+    def wrapper(*args, **kwargs):
+        global _last_error
+        try:
+            fn(*args, **kwargs)
+            return 0
+        except Exception as e:   # noqa: BLE001 — the C API catches all
+            _last_error = str(e)
+            return -1
+    wrapper.__name__ = fn.__name__
+    wrapper.__doc__ = fn.__doc__
+    return wrapper
+
+
+# ---------------------------------------------------------------------------
+# handle registry
+# ---------------------------------------------------------------------------
+
+class _DatasetEntry:
+    __slots__ = ("binned", "config", "raw_params", "feature_names")
+
+    def __init__(self, binned, config, raw_params):
+        self.binned = binned
+        self.config = config
+        self.raw_params = raw_params
+        self.feature_names = None
+
+
+class _BoosterEntry:
+    __slots__ = ("gbdt", "train", "valids", "custom_objective")
+
+    def __init__(self, gbdt, train):
+        self.gbdt = gbdt
+        self.train = train
+        self.valids = []
+        self.custom_objective = False
+
+
+_handles: Dict[int, object] = {}
+_next_handle = 1
+
+
+def _register(obj) -> int:
+    global _next_handle
+    h = _next_handle
+    _next_handle += 1
+    _handles[h] = obj
+    return h
+
+
+def _get(handle, cls):
+    obj = _handles.get(handle)
+    if not isinstance(obj, cls):
+        kind = "Dataset" if cls is _DatasetEntry else "Booster"
+        raise LightGBMError(f"invalid {kind} handle: {handle!r}")
+    return obj
+
+
+def _parse_params(parameters: Optional[str]) -> Config:
+    """Space-separated key=value string, the C API's parameter format."""
+    kv = {}
+    if parameters:
+        for tok in str(parameters).split():
+            if "=" in tok:
+                k, v = tok.split("=", 1)
+                kv[k] = v
+    return Config(kv)
+
+
+def _check_array(arr, name, dtype_const, allowed):
+    if dtype_const not in allowed:
+        raise LightGBMError(f"unsupported dtype constant for {name}: "
+                            f"{dtype_const}")
+    want = _DTYPE_MAP[dtype_const]
+    arr = np.asarray(arr)
+    if arr.dtype != want:
+        raise LightGBMError(
+            f"{name} dtype {arr.dtype} does not match declared "
+            f"C_API_DTYPE constant ({np.dtype(want)})")
+    return arr
+
+
+# ---------------------------------------------------------------------------
+# Dataset functions (c_api.h:50-335)
+# ---------------------------------------------------------------------------
+
+@_api
+def LGBM_DatasetCreateFromFile(filename, parameters, reference, out: Ref):
+    cfg = _parse_params(parameters)
+    ref = _get(reference, _DatasetEntry).binned if reference else None
+    from .cli import _load_dataset
+    binned = _load_dataset(str(filename), cfg, reference=ref)
+    out.value = _register(_DatasetEntry(binned, cfg, parameters))
+
+
+@_api
+def LGBM_DatasetCreateFromMat(data, data_type, nrow, ncol, is_row_major,
+                              parameters, reference, out: Ref):
+    data = _check_array(data, "data", data_type,
+                        (C_API_DTYPE_FLOAT32, C_API_DTYPE_FLOAT64))
+    mat = np.asarray(data).reshape(
+        (nrow, ncol) if is_row_major else (ncol, nrow))
+    if not is_row_major:
+        mat = mat.T
+    cfg = _parse_params(parameters)
+    ref = _get(reference, _DatasetEntry).binned if reference else None
+    binned = BinnedDataset.construct_from_matrix(
+        np.ascontiguousarray(mat, np.float64), cfg, reference=ref)
+    out.value = _register(_DatasetEntry(binned, cfg, parameters))
+
+
+@_api
+def LGBM_DatasetCreateFromCSR(indptr, indptr_type, indices, data, data_type,
+                              nindptr, nelem, num_col, parameters,
+                              reference, out: Ref):
+    indptr = _check_array(indptr, "indptr", indptr_type,
+                          (C_API_DTYPE_INT32, C_API_DTYPE_INT64))
+    data = _check_array(data, "data", data_type,
+                        (C_API_DTYPE_FLOAT32, C_API_DTYPE_FLOAT64))
+    indices = np.asarray(indices, np.int32)
+    if len(indptr) != nindptr:
+        raise LightGBMError("nindptr does not match indptr length")
+    cfg = _parse_params(parameters)
+    ref = _get(reference, _DatasetEntry).binned if reference else None
+    binned = BinnedDataset.construct_from_csr(
+        indptr[:nindptr], indices[:nelem],
+        np.asarray(data[:nelem], np.float64), int(num_col), cfg,
+        reference=ref)
+    out.value = _register(_DatasetEntry(binned, cfg, parameters))
+
+
+@_api
+def LGBM_DatasetGetSubset(handle, used_row_indices, num_used_row_indices,
+                          parameters, out: Ref):
+    entry = _get(handle, _DatasetEntry)
+    idx = np.asarray(used_row_indices, np.int32)[:num_used_row_indices]
+    sub = entry.binned.copy_subset(idx)
+    out.value = _register(_DatasetEntry(sub, entry.config, parameters))
+
+
+@_api
+def LGBM_DatasetSetFeatureNames(handle, feature_names, num_feature_names):
+    entry = _get(handle, _DatasetEntry)
+    names = [str(feature_names[i]) for i in range(num_feature_names)]
+    entry.binned.feature_names = names
+    entry.feature_names = names
+
+
+@_api
+def LGBM_DatasetGetFeatureNames(handle, out_strs: Ref, out_len: Ref):
+    entry = _get(handle, _DatasetEntry)
+    names = list(entry.binned.feature_names)
+    out_strs.value = names
+    out_len.value = len(names)
+
+
+@_api
+def LGBM_DatasetFree(handle):
+    _get(handle, _DatasetEntry)
+    del _handles[handle]
+
+
+@_api
+def LGBM_DatasetSaveBinary(handle, filename):
+    _get(handle, _DatasetEntry).binned.save_binary(str(filename))
+
+
+@_api
+def LGBM_DatasetSetField(handle, field_name, field_data, num_element,
+                         type_):
+    entry = _get(handle, _DatasetEntry)
+    md = entry.binned.metadata
+    if md is None:
+        md = entry.binned.metadata = Metadata(entry.binned.num_data)
+    name = str(field_name)
+    if name in ("label", "weight"):
+        data = _check_array(field_data, name, type_,
+                            (C_API_DTYPE_FLOAT32,))[:num_element]
+        (md.set_label if name == "label" else md.set_weights)(
+            np.asarray(data, np.float64))
+    elif name in ("group", "query"):
+        data = _check_array(field_data, name, type_,
+                            (C_API_DTYPE_INT32,))[:num_element]
+        md.set_query(np.asarray(data))
+    elif name == "init_score":
+        data = _check_array(field_data, name, type_,
+                            (C_API_DTYPE_FLOAT64,))[:num_element]
+        md.set_init_score(np.asarray(data, np.float64))
+    else:
+        raise LightGBMError(f"unknown field name: {name}")
+
+
+@_api
+def LGBM_DatasetGetField(handle, field_name, out_len: Ref, out_ptr: Ref,
+                         out_type: Ref):
+    md = _get(handle, _DatasetEntry).binned.metadata
+    name = str(field_name)
+    if md is None:
+        raise LightGBMError("dataset has no metadata")
+    if name == "label":
+        arr, t = md.label, C_API_DTYPE_FLOAT32
+        arr = None if arr is None else np.asarray(arr, np.float32)
+    elif name == "weight":
+        arr, t = md.weights, C_API_DTYPE_FLOAT32
+        arr = None if arr is None else np.asarray(arr, np.float32)
+    elif name in ("group", "query"):
+        arr, t = md.query_boundaries, C_API_DTYPE_INT32
+        arr = None if arr is None else np.asarray(arr, np.int32)
+    elif name == "init_score":
+        arr, t = md.init_score, C_API_DTYPE_FLOAT64
+        arr = None if arr is None else np.asarray(arr, np.float64)
+    else:
+        raise LightGBMError(f"unknown field name: {name}")
+    if arr is None:
+        raise LightGBMError(f"field {name} is not set")
+    out_ptr.value = arr
+    out_len.value = len(arr)
+    out_type.value = t
+
+
+@_api
+def LGBM_DatasetGetNumData(handle, out: Ref):
+    out.value = int(_get(handle, _DatasetEntry).binned.num_data)
+
+
+@_api
+def LGBM_DatasetGetNumFeature(handle, out: Ref):
+    out.value = int(_get(handle, _DatasetEntry).binned.num_total_features)
+
+
+# ---------------------------------------------------------------------------
+# Booster functions (c_api.h:341-797)
+# ---------------------------------------------------------------------------
+
+@_api
+def LGBM_BoosterCreate(train_data, parameters, out: Ref):
+    entry = _get(train_data, _DatasetEntry)
+    cfg = _parse_params(parameters)
+    gbdt = create_boosting(cfg)
+    gbdt.init_train(entry.binned)
+    out.value = _register(_BoosterEntry(gbdt, entry))
+
+
+@_api
+def LGBM_BoosterCreateFromModelfile(filename, out_num_iterations: Ref,
+                                    out: Ref):
+    gbdt = GBDT.load_model_from_file(str(filename))
+    out_num_iterations.value = gbdt.num_iterations()
+    out.value = _register(_BoosterEntry(gbdt, None))
+
+
+@_api
+def LGBM_BoosterLoadModelFromString(model_str, out_num_iterations: Ref,
+                                    out: Ref):
+    gbdt = GBDT.load_model_from_string(str(model_str))
+    out_num_iterations.value = gbdt.num_iterations()
+    out.value = _register(_BoosterEntry(gbdt, None))
+
+
+@_api
+def LGBM_BoosterFree(handle):
+    _get(handle, _BoosterEntry)
+    del _handles[handle]
+
+
+@_api
+def LGBM_BoosterAddValidData(handle, valid_data):
+    b = _get(handle, _BoosterEntry)
+    v = _get(valid_data, _DatasetEntry)
+    b.gbdt.add_valid(v.binned, f"valid_{len(b.valids)}")
+    b.valids.append(v)
+
+
+@_api
+def LGBM_BoosterGetNumClasses(handle, out_len: Ref):
+    out_len.value = max(
+        int(_get(handle, _BoosterEntry).gbdt.config.num_class), 1)
+
+
+@_api
+def LGBM_BoosterUpdateOneIter(handle, is_finished: Ref):
+    b = _get(handle, _BoosterEntry)
+    is_finished.value = 1 if b.gbdt.train_one_iter() else 0
+
+
+@_api
+def LGBM_BoosterUpdateOneIterCustom(handle, grad, hess, is_finished: Ref):
+    b = _get(handle, _BoosterEntry)
+    grad = np.asarray(grad, np.float32)
+    hess = np.asarray(hess, np.float32)
+    is_finished.value = 1 if b.gbdt.train_one_iter(grad, hess) else 0
+
+
+@_api
+def LGBM_BoosterRollbackOneIter(handle):
+    _get(handle, _BoosterEntry).gbdt.rollback_one_iter()
+
+
+@_api
+def LGBM_BoosterGetCurrentIteration(handle, out_iteration: Ref):
+    out_iteration.value = _get(handle, _BoosterEntry).gbdt.num_iterations()
+
+
+@_api
+def LGBM_BoosterNumModelPerIteration(handle, out_tree_per_iteration: Ref):
+    out_tree_per_iteration.value = _get(handle, _BoosterEntry).gbdt.num_model
+
+
+@_api
+def LGBM_BoosterNumberOfTotalModel(handle, out_models: Ref):
+    out_models.value = len(_get(handle, _BoosterEntry).gbdt.models)
+
+
+@_api
+def LGBM_BoosterGetEvalCounts(handle, out_len: Ref):
+    b = _get(handle, _BoosterEntry)
+    out_len.value = len(b.gbdt.train_metrics)
+
+
+@_api
+def LGBM_BoosterGetEvalNames(handle, out_len: Ref, out_strs: Ref):
+    b = _get(handle, _BoosterEntry)
+    names = [m.name for m in b.gbdt.train_metrics]
+    out_strs.value = names
+    out_len.value = len(names)
+
+
+@_api
+def LGBM_BoosterGetEval(handle, data_idx, out_len: Ref, out_results):
+    """data_idx 0 = training data, >=1 = validation sets (c_api.cpp)."""
+    b = _get(handle, _BoosterEntry)
+    if data_idx == 0:
+        res = b.gbdt.eval_train()
+    else:
+        allv = b.gbdt.eval_valid()
+        name = f"valid_{data_idx - 1}"
+        res = [r for r in allv if r[0] == name]
+    vals = [v for (_, _, v, _) in res]
+    out_results[:len(vals)] = vals
+    out_len.value = len(vals)
+
+
+def _num_preds(gbdt, nrow, predict_type, num_iteration):
+    total_iter = gbdt.num_iterations()
+    it = total_iter if num_iteration <= 0 else min(num_iteration,
+                                                   total_iter)
+    if predict_type == C_API_PREDICT_LEAF_INDEX:
+        return nrow * gbdt.num_model * it
+    if predict_type == C_API_PREDICT_CONTRIB:
+        return nrow * gbdt.num_model * (gbdt.max_feature_idx + 2)
+    return nrow * gbdt.num_model
+
+
+@_api
+def LGBM_BoosterCalcNumPredict(handle, num_row, predict_type,
+                               num_iteration, out_len: Ref):
+    b = _get(handle, _BoosterEntry)
+    out_len.value = _num_preds(b.gbdt, num_row, predict_type,
+                               num_iteration)
+
+
+def _predict_dense(gbdt, mat, predict_type, num_iteration, out_len: Ref,
+                   out_result):
+    if predict_type == C_API_PREDICT_LEAF_INDEX:
+        res = gbdt.predict(mat, num_iteration=num_iteration,
+                           pred_leaf=True)
+    elif predict_type == C_API_PREDICT_CONTRIB:
+        res = gbdt.predict(mat, num_iteration=num_iteration,
+                           pred_contrib=True)
+    elif predict_type == C_API_PREDICT_RAW_SCORE:
+        res = gbdt.predict(mat, num_iteration=num_iteration,
+                           raw_score=True)
+    else:
+        res = gbdt.predict(mat, num_iteration=num_iteration)
+    flat = np.asarray(res, np.float64).reshape(-1)
+    out_result[:len(flat)] = flat
+    out_len.value = len(flat)
+
+
+@_api
+def LGBM_BoosterPredictForMat(handle, data, data_type, nrow, ncol,
+                              is_row_major, predict_type, num_iteration,
+                              parameter, out_len: Ref, out_result):
+    b = _get(handle, _BoosterEntry)
+    data = _check_array(data, "data", data_type,
+                        (C_API_DTYPE_FLOAT32, C_API_DTYPE_FLOAT64))
+    mat = np.asarray(data).reshape(
+        (nrow, ncol) if is_row_major else (ncol, nrow))
+    if not is_row_major:
+        mat = mat.T
+    _predict_dense(b.gbdt, np.asarray(mat, np.float64), predict_type,
+                   num_iteration, out_len, out_result)
+
+
+@_api
+def LGBM_BoosterPredictForCSR(handle, indptr, indptr_type, indices, data,
+                              data_type, nindptr, nelem, num_col,
+                              predict_type, num_iteration, parameter,
+                              out_len: Ref, out_result):
+    b = _get(handle, _BoosterEntry)
+    indptr = _check_array(indptr, "indptr", indptr_type,
+                          (C_API_DTYPE_INT32, C_API_DTYPE_INT64))
+    data = _check_array(data, "data", data_type,
+                        (C_API_DTYPE_FLOAT32, C_API_DTYPE_FLOAT64))
+    indices = np.asarray(indices, np.int32)
+    nrow = int(nindptr) - 1
+    mat = np.zeros((nrow, int(num_col)), np.float64)
+    counts = np.diff(np.asarray(indptr[:nrow + 1], np.int64))
+    rows = np.repeat(np.arange(nrow, dtype=np.int64), counts)
+    nnz = len(rows)
+    mat[rows, indices[:nnz]] = np.asarray(data[:nnz], np.float64)
+    _predict_dense(b.gbdt, mat, predict_type, num_iteration, out_len,
+                   out_result)
+
+
+@_api
+def LGBM_BoosterSaveModel(handle, start_iteration, num_iteration,
+                          filename):
+    _get(handle, _BoosterEntry).gbdt.save_model_to_file(
+        str(filename), start_iteration, num_iteration)
+
+
+@_api
+def LGBM_BoosterSaveModelToString(handle, start_iteration, num_iteration,
+                                  buffer_len, out_len: Ref, out_str: Ref):
+    s = _get(handle, _BoosterEntry).gbdt.model_to_string(
+        start_iteration, num_iteration)
+    out_str.value = s
+    out_len.value = len(s) + 1
+
+
+@_api
+def LGBM_BoosterDumpModel(handle, start_iteration, num_iteration,
+                          buffer_len, out_len: Ref, out_str: Ref):
+    b = _get(handle, _BoosterEntry)
+    b.gbdt._flush_pending()
+    dump = {
+        "name": "tree",
+        "version": "v2",
+        "num_class": max(int(b.gbdt.config.num_class), 1),
+        "num_tree_per_iteration": b.gbdt.num_model,
+        "label_index": 0,
+        "max_feature_idx": b.gbdt.max_feature_idx,
+        "feature_names": list(b.gbdt.feature_names),
+        "tree_info": [t.to_json() for t in b.gbdt.models],
+    }
+    s = json.dumps(dump)
+    out_str.value = s
+    out_len.value = len(s) + 1
+
+
+@_api
+def LGBM_BoosterFeatureImportance(handle, num_iteration, importance_type,
+                                  out_results):
+    b = _get(handle, _BoosterEntry)
+    imp = b.gbdt.feature_importance(
+        "split" if importance_type == 0 else "gain", num_iteration)
+    out_results[:len(imp)] = imp
+
+
+# ---------------------------------------------------------------------------
+# Network functions (c_api.h:799-815)
+# ---------------------------------------------------------------------------
+
+_network_conf = {"num_machines": 1, "rank": 0}
+
+
+@_api
+def LGBM_NetworkInit(machines, local_listen_port, listen_time_out,
+                     num_machines):
+    """Single-controller JAX owns process wiring (SURVEY §2.4: socket/MPI
+    linkers are subsumed by ICI/`jax.distributed`); this records the
+    topology request so ported clients keep working and multi-host
+    configs route through `parallel.network`."""
+    _network_conf["num_machines"] = int(num_machines)
+    _network_conf["rank"] = 0
+
+
+@_api
+def LGBM_NetworkFree():
+    _network_conf["num_machines"] = 1
+    _network_conf["rank"] = 0

@@ -309,6 +309,95 @@ class BinnedDataset:
         ds._build_feature_lookups(config)
         return ds
 
+    # -- streaming (two-round) construction --------------------------------
+    @classmethod
+    def construct_streaming_begin(
+            cls, sample: np.ndarray, n_total: int, num_cols: int, config,
+            categorical: Sequence[int] = (),
+            feature_names: Optional[Sequence[str]] = None,
+            reference: Optional["BinnedDataset"] = None,
+    ) -> "BinnedDataset":
+        """Start a two-round streaming construction: bins and bundles are
+        found from ``sample`` (a ``bin_construct_sample_cnt``-row matrix)
+        scaled to ``n_total`` rows, the ``(N, G)`` uint8 matrix is
+        preallocated, and chunks arrive via
+        :meth:`construct_streaming_push` (reference
+        ``dataset_loader.cpp:161-264`` two-round load)."""
+        ds = cls()
+        ds.num_data = int(n_total)
+        ds.num_total_features = int(num_cols)
+        ds.metadata = Metadata(ds.num_data)
+        ds.feature_names = ([f"Column_{i}" for i in range(num_cols)]
+                            if feature_names is None
+                            else list(feature_names))
+        if reference is not None:
+            if num_cols != reference.num_total_features:
+                raise LightGBMError(
+                    f"data has {num_cols} features, reference has "
+                    f"{reference.num_total_features}")
+            ds._align_with_reference_shared(reference)
+            ds.binned = np.zeros((ds.num_data, len(ds.groups)), np.uint8)
+            return ds
+
+        sample = np.asarray(sample, np.float64)
+        sample_cnt = sample.shape[0]
+        # filter count scaled to the sample (dataset_loader.cpp:787)
+        filter_cnt = int(0.95 * config.min_data_in_leaf
+                         / max(n_total, 1) * sample_cnt)
+        cat = set(int(c) for c in categorical)
+        ds.bin_mappers = []
+        nz_masks = {}
+        nz_counts = {}
+        for f in range(num_cols):
+            col = sample[:, f]
+            mask = (col != 0.0) | np.isnan(col)
+            recorded = col[mask]
+            m = BinMapper()
+            m.find_bin(recorded, sample_cnt, config.max_bin,
+                       config.min_data_in_bin, filter_cnt,
+                       BIN_CATEGORICAL if f in cat else BIN_NUMERICAL,
+                       config.use_missing, config.zero_as_missing)
+            ds.bin_mappers.append(m)
+            nz_masks[f] = mask
+            nz_counts[f] = int(mask.sum())
+        ds.used_features = [f for f in range(num_cols)
+                            if not ds.bin_mappers[f].is_trivial]
+        if not ds.used_features:
+            log_warning("There are no meaningful features, as all feature "
+                        "values are constant.")
+            ds.groups = []
+        elif not config.enable_bundle or len(ds.used_features) == 1:
+            ds._set_groups([[f] for f in ds.used_features])
+        else:
+            ds._set_groups(ds._bundle_from_masks(config, nz_masks,
+                                                 nz_counts, sample_cnt))
+        ds._build_feature_lookups(config)
+        ds.binned = np.zeros((ds.num_data, len(ds.groups)), np.uint8)
+        return ds
+
+    def construct_streaming_push(self, chunk: np.ndarray,
+                                 start_row: int) -> None:
+        """Bin ``chunk`` rows into ``binned[start_row:...]`` (the analog
+        of ``Dataset::PushOneRow``, dataset.h:318-341, chunk-vectorized).
+        """
+        chunk = np.asarray(chunk, np.float64)
+        end = start_row + chunk.shape[0]
+        if end > self.num_data:
+            raise LightGBMError("streaming push beyond declared num_data")
+        out = self.binned[start_row:end]
+        for gid, group in enumerate(self.groups):
+            col_out = out[:, gid]
+            for sub, f in enumerate(group.feature_indices):
+                m = self.bin_mappers[f]
+                bins = m.values_to_bins(chunk[:, f])
+                offset = group.bin_offsets[sub]
+                slot = bins + offset - (1 if m.default_bin == 0 else 0)
+                non_default = bins != m.default_bin
+                col_out[non_default] = slot[non_default].astype(np.uint8)
+
+    def construct_streaming_finish(self) -> None:
+        """End of the stream (placeholder for integrity checks)."""
+
     def _set_groups(self, feature_groups) -> None:
         self.groups = [FeatureGroupInfo(g, [self.bin_mappers[f] for f in g])
                        for g in feature_groups]

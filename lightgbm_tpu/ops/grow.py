@@ -113,50 +113,65 @@ class DeviceGrower:
         self.p_num_bin = i32(nbins)
         self.p_missing = i32(dataset.f_missing_type)
 
-        # wave width: 5 stat columns per leaf (g hi/lo, h hi/lo, count);
-        # 25 leaves x 5 = 125 columns fills exactly one 128-lane MXU tile
-        # (200 columns at W=40 measured ~2x slower per wave)
-        # (W=40 and W=51 measured 974/981 ms per tree vs 720 ms at W=25 on
-        # the 10.5M-row benchmark: the extra column tiles cost more than
-        # the saved waves)
-        self.wave_width = min(25, max(self.num_leaves - 1, 1))
+        # stat columns per leaf in the wave matmul.  Default 3 — bf16
+        # g/h + exact count: per-term bf16 rounding (rel ~2^-8) is
+        # uncorrelated across a bin's rows, so bin sums stay accurate to
+        # ~1e-5 relative (measured; cf. the reference GPU learner's f32
+        # histograms, docs/GPU-Performance.rst:128-161).  gpu_use_dp
+        # restores the hi/lo split (g,h each as two bf16 columns whose
+        # f32-accumulated sum reconstructs f32-exact values).
+        self.hist_cols = 5 if getattr(config, "gpu_use_dp", False) else 3
+        # wave width: total columns (W x hist_cols) should fill but not
+        # exceed one 128-lane MXU tile; per-wave matmul cost is
+        # proportional to the column-tile count, so 126 cols at W=42
+        # costs the same per wave as 75 at W=25 but covers 1.68x more
+        # leaves -> proportionally fewer waves per tree.  (r3 measured
+        # W=40 at 5 cols = 200 columns ~2x slower per wave: two tiles.)
+        self.wave_width = min(126 // self.hist_cols,
+                              max(self.num_leaves - 1, 1))
         self.lr = float(config.learning_rate)
         self._grow = jax.jit(self._grow_impl)
 
     # ------------------------------------------------------------------
     # wave histogram: one dense pass for up to W pending leaves
     # ------------------------------------------------------------------
-    def _wave_hist(self, binned, leaf_id, gh5, pending):
-        """(n_pad,) leaf ids, (n_pad, 5) bf16 [g_hi,g_lo,h_hi,h_lo,1],
-        (W,) pending leaf ids (-1 = empty slot) -> (W, S, 3) f32.
+    def _wave_hist(self, binned, leaf_id, ghk, pending):
+        """(n_pad,) leaf ids, (n_pad, K) bf16 stat columns (K=3:
+        [g,h,1]; K=5: [g_hi,g_lo,h_hi,h_lo,1]), (W,) pending leaf ids
+        (-1 = empty slot) -> (W, S, 3) f32.
 
         The one-hot must stay a bare iota-compare so XLA fuses its
         generation into the dot operand (a multi-hot built as
         ``one_hot(..).sum()`` materializes in HBM measured 3.5x slower;
         fusing the leaf-id split application into this scan also measured
         2x slower - the extra data dependency breaks matmul pipelining)."""
-        g, nb, w = self.num_groups, self.nb, self.wave_width
+        g, nb = self.num_groups, self.nb
+        w = pending.shape[0]
+        k = self.hist_cols
         ch = _CHUNK
         n_chunks = self.n_pad // ch
         binned_c = binned.reshape(n_chunks, ch, g)
         leaf_c = leaf_id.reshape(n_chunks, ch)
-        gh5_c = gh5.reshape(n_chunks, ch, 5)
+        ghk_c = ghk.reshape(n_chunks, ch, k)
 
         def body(acc, xs):
-            b, l, g5 = xs
+            b, l, gk = xs
             oh = jax.nn.one_hot(b, nb, dtype=jnp.bfloat16)       # (CH,G,NB)
             lm = (l[:, None] == pending[None, :]).astype(jnp.bfloat16)
-            bmat = (lm[:, :, None] * g5[:, None, :]).reshape(ch, w * 5)
+            bmat = (lm[:, :, None] * gk[:, None, :]).reshape(ch, w * k)
             out = jnp.einsum("cgn,cb->gnb", oh, bmat,
                              preferred_element_type=jnp.float32)
             return acc + out, None
 
-        acc0 = jnp.zeros((g, nb, w * 5), jnp.float32)
-        acc, _ = jax.lax.scan(body, acc0, (binned_c, leaf_c, gh5_c))
-        acc = acc.reshape(g, nb, w, 5)
-        hist = jnp.stack([acc[..., 0] + acc[..., 1],
-                          acc[..., 2] + acc[..., 3],
-                          acc[..., 4]], axis=-1)                 # (G,NB,W,3)
+        acc0 = jnp.zeros((g, nb, w * k), jnp.float32)
+        acc, _ = jax.lax.scan(body, acc0, (binned_c, leaf_c, ghk_c))
+        acc = acc.reshape(g, nb, w, k)
+        if k == 5:
+            hist = jnp.stack([acc[..., 0] + acc[..., 1],
+                              acc[..., 2] + acc[..., 3],
+                              acc[..., 4]], axis=-1)             # (G,NB,W,3)
+        else:
+            hist = acc                                           # (G,NB,W,3)
         return hist.transpose(2, 0, 1, 3).reshape(w, self.num_slots, 3)
 
     # ------------------------------------------------------------------
@@ -191,13 +206,17 @@ class DeviceGrower:
 
         grad = jnp.pad(grad, (0, npad_rows))
         hess = jnp.pad(hess, (0, npad_rows))
-        ghi = grad.astype(jnp.bfloat16)
-        glo = (grad - ghi.astype(jnp.float32)).astype(jnp.bfloat16)
-        hhi = hess.astype(jnp.bfloat16)
-        hlo = (hess - hhi.astype(jnp.float32)).astype(jnp.bfloat16)
         one = jnp.where(jnp.arange(n) < self.num_data, 1.0, 0.0
                         ).astype(jnp.bfloat16)
-        gh5 = jnp.stack([ghi * one, glo * one, hhi * one, hlo * one, one], 1)
+        ghi = grad.astype(jnp.bfloat16)
+        hhi = hess.astype(jnp.bfloat16)
+        if self.hist_cols == 5:
+            glo = (grad - ghi.astype(jnp.float32)).astype(jnp.bfloat16)
+            hlo = (hess - hhi.astype(jnp.float32)).astype(jnp.bfloat16)
+            gh5 = jnp.stack([ghi * one, glo * one, hhi * one, hlo * one,
+                             one], 1)
+        else:
+            gh5 = jnp.stack([ghi * one, hhi * one, one], 1)
 
         leaf_id0 = jnp.where(jnp.arange(n, dtype=jnp.int32) < self.num_data,
                              0, -1)
@@ -210,6 +229,7 @@ class DeviceGrower:
             depth: jnp.ndarray          # (L+1,) i32
             best: jnp.ndarray           # (L+1, 13) f32, gain NEG_INF if none
             nl: jnp.ndarray             # i32 leaves so far
+            waves: jnp.ndarray          # i32 wave count (profiling)
             done: jnp.ndarray           # bool
             rec_i: jnp.ndarray          # (L, 5) i32   (last row = junk)
             rec_f: jnp.ndarray          # (L, 9) f32   (last row = junk)
@@ -221,6 +241,7 @@ class DeviceGrower:
         # index L-1) absorbing vector-scatter writes from empty lanes, so
         # scatters never collide with live leaves
         neg = jnp.full((L + 1, 13), NEG_INF, jnp.float32)
+        W0 = min(4, W) if (4 < W and 8 < L) else W   # first stage width
         init = _S(
             leaf_id=leaf_id0,
             hist=jnp.zeros((L + 1, S, 3), jnp.float32),
@@ -229,14 +250,15 @@ class DeviceGrower:
             depth=jnp.zeros((L + 1,), jnp.int32),
             best=neg,
             nl=jnp.asarray(1, jnp.int32),
+            waves=jnp.asarray(0, jnp.int32),
             done=jnp.asarray(False),
             rec_i=jnp.full((L, REC_I_FIELDS), -1, jnp.int32),
             rec_f=jnp.zeros((L, REC_F_FIELDS), jnp.float32),
-            p_parent=jnp.full((W,), -1, jnp.int32),
+            p_parent=jnp.full((W0,), -1, jnp.int32),
             p_small=jnp.concatenate([jnp.zeros(1, jnp.int32),
-                                     jnp.full((W - 1,), -1, jnp.int32)])
-            if W > 1 else jnp.zeros((1,), jnp.int32),
-            p_large=jnp.full((W,), -1, jnp.int32),
+                                     jnp.full((W0 - 1,), -1, jnp.int32)])
+            if W0 > 1 else jnp.zeros((1,), jnp.int32),
+            p_large=jnp.full((W0,), -1, jnp.int32),
         )
 
         find_one = functools.partial(find_best_split_impl, meta=self.meta,
@@ -252,7 +274,8 @@ class DeviceGrower:
             gain = jnp.where(ok, packed[:, F_GAIN], NEG_INF)
             return packed.at[:, F_GAIN].set(gain)
 
-        def wave(st: _S) -> _S:
+        def make_wave(Ws: int):
+          def wave(st: _S) -> _S:
             # 1. fresh histograms for pending smaller children
             fresh = self._wave_hist(binned, st.leaf_id, gh5,
                                     st.p_small)               # (W,S,3)
@@ -294,11 +317,11 @@ class DeviceGrower:
             best = st.best.at[safe].set(
                 jnp.where((ids >= 0)[:, None], packed, st.best[safe]))
 
-            # 4. select up to W best-gain splits within budget
+            # 4. select up to Ws best-gain splits within budget
             gains = best[:L, F_GAIN]
-            top_vals, top_idx = jax.lax.top_k(gains, W)
+            top_vals, top_idx = jax.lax.top_k(gains, Ws)
             budget = (L - st.nl).astype(jnp.int32)
-            sel = (top_vals > 0.0) & (jnp.arange(W) < budget)
+            sel = (top_vals > 0.0) & (jnp.arange(Ws) < budget)
             napply = sel.sum().astype(jnp.int32)
             rank = jnp.cumsum(sel.astype(jnp.int32)) - 1
 
@@ -319,23 +342,27 @@ class DeviceGrower:
             miss = self.p_missing[f]
             def_left = jnp.where(miss == 1, dl, db <= thr)    # (W,)
 
-            # leaf_id update: one fused dense pass over contiguous (G, N)
-            # feature rows; masks are disjoint (a row belongs to at most
-            # one selected leaf)
-            upd = jnp.zeros((n,), jnp.int32)
-            for w in range(W):
-                colw = jax.lax.dynamic_slice(
-                    binned_t, (grp[w], 0), (1, n))[0].astype(jnp.int32)
-                shift = jnp.where(db[w] == 0, 1, 0)
-                in_range = (colw >= off[w]) & (colw < off[w] + wid[w])
-                bin_ = jnp.where(in_range, colw - off[w] + shift, db[w])
-                is_default = bin_ == db[w]
-                is_na = (miss[w] == 2) & (bin_ == nbin[w] - 1)
-                goes_left = jnp.where(is_default, def_left[w],
-                                      jnp.where(is_na, dl[w],
-                                                bin_ <= thr[w]))
-                mask = sel[w] & (st.leaf_id == lsel[w]) & ~goes_left
-                upd = upd + jnp.where(mask, r_ids[w] - lsel[w], 0)
+            # leaf_id update: ONE fused vectorized pass over the W
+            # selected feature rows of the contiguous (G, N) matrix
+            # (replaces r3's W-times-unrolled dynamic-slice loop, which
+            # re-read leaf_id and re-wrote the update vector per split).
+            # Masks are disjoint (a row belongs to at most one selected
+            # leaf), so the masked deltas sum without collisions.
+            cols = jnp.take(binned_t, grp, axis=0).astype(jnp.int32)  # (W,N)
+            shift = jnp.where(db == 0, 1, 0)[:, None]
+            in_range = (cols >= off[:, None]) & (cols
+                                                 < (off + wid)[:, None])
+            bin_ = jnp.where(in_range, cols - off[:, None] + shift,
+                             db[:, None])
+            is_default = bin_ == db[:, None]
+            is_na = (miss[:, None] == 2) & (bin_ == (nbin - 1)[:, None])
+            goes_left = jnp.where(is_default, def_left[:, None],
+                                  jnp.where(is_na, dl[:, None],
+                                            bin_ <= thr[:, None]))
+            mask = (sel[:, None] & (st.leaf_id[None, :] == lsel[:, None])
+                    & ~goes_left)
+            upd = jnp.sum(mask * (r_ids - lsel)[:, None], axis=0,
+                          dtype=jnp.int32)
             leaf_id = st.leaf_id + upd
 
             # bookkeeping (vectorized scatters into the L-padded arrays)
@@ -381,13 +408,38 @@ class DeviceGrower:
 
             return _S(leaf_id=leaf_id, hist=hist, total=total, value=value,
                       depth=depth, best=best, nl=st.nl + napply,
-                      done=napply == 0, rec_i=rec_i, rec_f=rec_f,
+                      waves=st.waves + 1, done=napply == 0,
+                      rec_i=rec_i, rec_f=rec_f,
                       p_parent=pp, p_small=ps, p_large=pl)
+          return wave
 
-        def cond(st: _S):
-            return (~st.done) & (st.nl < L)
+        # staged wave widths: the early frontier has 1 -> 2 -> 4 -> ...
+        # pending leaves, so a full-width wave wastes almost its whole
+        # column tile on empty lanes (the matmul cost is W x hist_cols
+        # columns regardless of how many are live).  Growing the width
+        # with the frontier cuts the early waves' cost ~5-10x; each stage
+        # is its own while_loop over the same state with the pending
+        # arrays padded to the next width.
+        def resize(st: _S, w_to: int) -> _S:
+            pad = w_to - st.p_parent.shape[0]
+            if pad <= 0:
+                return st
+            ext = jnp.full((pad,), -1, jnp.int32)
+            return st._replace(
+                p_parent=jnp.concatenate([st.p_parent, ext]),
+                p_small=jnp.concatenate([st.p_small, ext]),
+                p_large=jnp.concatenate([st.p_large, ext]))
 
-        final = jax.lax.while_loop(cond, wave, init)
+        plan = [(ws, cap) for ws, cap in ((4, 8), (16, 32))
+                if ws < W and cap < L] + [(W, None)]
+        st = init
+        for ws, cap in plan:
+            st = resize(st, ws)
+            limit = L if cap is None else min(cap, L)
+            st = jax.lax.while_loop(
+                lambda s, lim=limit: (~s.done) & (s.nl < lim),
+                make_wave(ws), st)
+        final = st
         leaf_final = final.leaf_id
 
         # score update: score[row] += lr * value[leaf_id[row]] via one-hot
@@ -404,17 +456,113 @@ class DeviceGrower:
         new_score = score + (upd[:, 0] + upd[:, 1])[:self.num_data]
 
         return (new_score, final.rec_i[:max(L - 1, 1)],
-                final.rec_f[:max(L - 1, 1)], final.nl, final.value[0])
+                final.rec_f[:max(L - 1, 1)], final.nl, final.value[0],
+                final.waves)
 
     # ------------------------------------------------------------------
     def grow_one_iter(self, score, grad, hess, feature_mask, lr=None):
         """Dispatch one boosting iteration; returns device handles
-        (new_score, rec_i, rec_f, num_leaves, root_value) without blocking.
-        """
+        (new_score, rec_i, rec_f, num_leaves, root_value, num_waves)
+        without blocking."""
         if lr is None:
             lr = self.lr
         return self._grow(self.binned, self.binned_t, score, grad, hess,
                           feature_mask, jnp.asarray(lr, jnp.float32))
+
+
+    # ------------------------------------------------------------------
+    def profile_phases(self, grad, hess, reps: int = 3) -> dict:
+        """Honest per-phase attribution for one wave (bench --profile).
+
+        The production grower runs the whole tree inside one
+        ``lax.while_loop`` — individual phases are invisible from the
+        host.  This method times separately-jitted programs equivalent
+        to the wave's phases on the real binned matrices and a
+        representative leaf state (rows spread over W leaves, all
+        pending), syncing after each, and returns {phase: ms}.
+        """
+        import time as _time
+
+        w, n = self.wave_width, self.n_pad
+        rng = np.random.default_rng(0)
+        leaf_id = jnp.asarray(
+            rng.integers(0, w, n).astype(np.int32))
+        pending = jnp.arange(w, dtype=jnp.int32)
+        grad = jnp.pad(grad, (0, n - self.num_data))
+        hess = jnp.pad(hess, (0, n - self.num_data))
+
+        k = self.hist_cols
+
+        @jax.jit
+        def p_hist(binned, leaf, g, h, pend):
+            one = jnp.ones((n,), jnp.bfloat16)
+            ghi = g.astype(jnp.bfloat16)
+            hhi = h.astype(jnp.bfloat16)
+            if k == 5:
+                glo = (g - ghi.astype(jnp.float32)).astype(jnp.bfloat16)
+                hlo = (h - hhi.astype(jnp.float32)).astype(jnp.bfloat16)
+                ghk = jnp.stack([ghi, glo, hhi, hlo, one], 1)
+            else:
+                ghk = jnp.stack([ghi, hhi, one], 1)
+            return self._wave_hist(binned, leaf, ghk, pend)
+
+        @jax.jit
+        def p_find(hists, feature_mask):
+            find_one = functools.partial(find_best_split_impl,
+                                         meta=self.meta, hp=self.hyper,
+                                         has_cat=False)
+            cons = jnp.asarray([-jnp.inf, jnp.inf], jnp.float32)
+            totals = hists[:, :self.nb, :].sum(1)
+            packed, _ = jax.vmap(
+                lambda hh, t: find_one(hh, t, cons, feature_mask))(hists,
+                                                                   totals)
+            return packed
+
+        @jax.jit
+        def p_apply(binned_t, leaf, grp, thr, rdel):
+            cols = jnp.take(binned_t, grp, axis=0).astype(jnp.int32)
+            mask = (leaf[None, :] == jnp.arange(w)[:, None]) \
+                & (cols > thr[:, None])
+            return leaf + jnp.sum(mask * rdel[:, None], axis=0,
+                                  dtype=jnp.int32)
+
+        @jax.jit
+        def p_score(score, leaf, vals):
+            L = self.num_leaves
+            oh = jax.nn.one_hot(leaf, L, dtype=jnp.bfloat16)
+            vhi = vals.astype(jnp.bfloat16)
+            vlo = (vals - vhi.astype(jnp.float32)).astype(jnp.bfloat16)
+            upd = jnp.einsum("nl,lk->nk", oh, jnp.stack([vhi, vlo], 1),
+                             preferred_element_type=jnp.float32)
+            return score + upd[:, 0] + upd[:, 1]
+
+        mask = jnp.ones((len(np.asarray(self.p_group)),), bool)
+        grp = jnp.asarray(rng.integers(0, self.num_groups, w, np.int32))
+        thr = jnp.asarray(rng.integers(0, self.nb, w, np.int32))
+        rdel = jnp.asarray(rng.integers(1, w + 1, w, np.int32))
+        vals = jnp.asarray(rng.standard_normal(self.num_leaves)
+                           .astype(np.float32))
+        score = jnp.zeros((n,), jnp.float32)
+
+        out = {}
+        cases = {
+            "wave_hist": lambda: p_hist(self.binned, leaf_id, grad, hess,
+                                        pending),
+            "find_best": None,   # filled after hist exists
+            "split_apply": lambda: p_apply(self.binned_t, leaf_id, grp,
+                                           thr, rdel),
+            "score_update": lambda: p_score(score, leaf_id, vals),
+        }
+        hists = jax.block_until_ready(cases["wave_hist"]())
+        cases["find_best"] = lambda: p_find(hists, mask)
+        for name, fn in cases.items():
+            jax.block_until_ready(fn())          # compile + warm
+            t0 = _time.perf_counter()
+            for _ in range(reps):
+                r = fn()
+            jax.block_until_ready(r)
+            out[name] = round((_time.perf_counter() - t0) / reps * 1e3, 2)
+        return out
 
 
 def device_growth_eligible(config, dataset, objective, num_model) -> bool:
