@@ -13,6 +13,7 @@ class DART(GBDT):
     the training score, train on the modified residual, then run the
     three-step normalization (dart.hpp:86-186)."""
 
+
     def init_train(self, train_set, objective=None):
         super().init_train(train_set, objective)
         self._drop_rng = np.random.RandomState(
@@ -63,6 +64,12 @@ class DART(GBDT):
                         if (cfg.max_drop > 0
                                 and len(self.drop_index) >= cfg.max_drop):
                             break
+        # device path: dropped trees are re-scaled in place, so pending
+        # device records must be materialized first — but only when
+        # something was actually dropped (flushing blocks the dispatch
+        # pipeline; skip_drop iterations stay fully async)
+        if self.drop_index and self._grower is not None:
+            self._flush_pending()
         # subtract dropped trees from the training score
         for i in self.drop_index:
             for k in range(self.num_model):
@@ -78,6 +85,11 @@ class DART(GBDT):
                                    / (cfg.learning_rate + k_drop))
 
     def _normalize(self):
+        # device path: normalize edits valid scores with per-tree deltas,
+        # which is only sound once every prior tree actually reached the
+        # valid scores (they are caught up lazily)
+        if self._grower is not None and self.valid_sets:
+            self._catch_up_valid_scores()
         cfg = self.config
         k = float(len(self.drop_index))
         for i in self.drop_index:

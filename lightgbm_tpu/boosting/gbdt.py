@@ -50,16 +50,19 @@ class _PendingTree:
     """Device-side split records of a tree grown by the DeviceGrower;
     replayed into a host ``Tree`` lazily (``GBDT._flush_pending``)."""
 
-    __slots__ = ("rec_i", "rec_f", "nl", "root_value", "shrinkage", "bias")
+    __slots__ = ("rec_i", "rec_f", "rec_c", "nl", "root_value",
+                 "shrinkage", "bias")
 
-    def __init__(self, rec_i, rec_f, nl, root_value, shrinkage, bias):
+    def __init__(self, rec_i, rec_f, rec_c, nl, root_value, shrinkage,
+                 bias):
         self.rec_i = rec_i
         self.rec_f = rec_f
+        self.rec_c = rec_c
         self.nl = nl
         self.root_value = root_value
         self.shrinkage = shrinkage
         self.bias = bias
-        for arr in (rec_i, rec_f, nl, root_value):
+        for arr in (rec_i, rec_f, rec_c, nl, root_value):
             try:
                 arr.copy_to_host_async()
             except AttributeError:
@@ -76,8 +79,11 @@ class _PendingTree:
             # GBDT.train_one_iter's stump branch
             tree.leaf_value[0] = 0.0
         else:
+            from ..tree.tree import construct_bitset
             rec_i = np.asarray(self.rec_i)
             rec_f = np.asarray(self.rec_f)
+            rec_c = np.asarray(self.rec_c)
+            is_cat_f = np.asarray(dataset.f_is_categorical)
             for s in range(nl - 1):
                 leaf, right, f, thr, dl = (int(v) for v in rec_i[s])
                 (gain, lg, lh, lc, rg, rh, rc, lout, rout) = (
@@ -85,9 +91,24 @@ class _PendingTree:
                 real_f = dataset.used_features[f]
                 mapper = dataset.bin_mappers[real_f]
                 missing = int(dataset.f_missing_type[f])
-                tree.split(leaf, f, real_f, thr,
-                           mapper.bin_to_value(thr), lout, rout, int(lc),
-                           int(rc), gain, missing, bool(dl))
+                if is_cat_f[f]:
+                    words = rec_c[s].astype(np.uint32)
+                    member_bins = [
+                        b for b in range(min(mapper.num_bin, 256))
+                        if (words[b >> 5] >> (b & 31)) & 1]
+                    bitset_inner = construct_bitset(member_bins)
+                    cats = [int(mapper.bin_2_categorical[b])
+                            for b in member_bins
+                            if b < len(mapper.bin_2_categorical)
+                            and mapper.bin_2_categorical[b] >= 0]
+                    tree.split_categorical(
+                        leaf, f, real_f, bitset_inner,
+                        construct_bitset(cats), lout, rout, int(lc),
+                        int(rc), gain, missing)
+                else:
+                    tree.split(leaf, f, real_f, thr,
+                               mapper.bin_to_value(thr), lout, rout,
+                               int(lc), int(rc), gain, missing, bool(dl))
             tree.apply_shrinkage(self.shrinkage)
         if abs(self.bias) > K_EPSILON:
             tree.add_bias(self.bias)
@@ -184,7 +205,7 @@ class GBDT:
         mode = str(getattr(cfg, "device_growth", "off")).lower()
         want = mode == "on" or (mode == "auto"
                                 and jax.default_backend() == "tpu")
-        if want and type(self) is GBDT:
+        if want:
             serial = (cfg.tree_learner == "serial"
                       or int(cfg.num_machines) <= 1)
             if serial and device_growth_eligible(cfg, train_set,
@@ -195,8 +216,8 @@ class GBDT:
                          f"{mode})")
             elif mode == "on":
                 log_warning("device_growth=on requested but the "
-                            "configuration is not eligible (categorical/"
-                            "monotone/bagging/multiclass/renew objective); "
+                            "configuration is not eligible (monotone "
+                            "constraints/renew objective/forced splits); "
                             "falling back to the host-driven learner")
 
     def add_valid(self, valid_set: BinnedDataset, name: str):
@@ -329,35 +350,79 @@ class GBDT:
         return False
 
     # ------------------------------------------------------------------
-    # on-device fast path: one dispatch per iteration, no per-split sync
+    # on-device fast path: one dispatch per class per iteration, no
+    # per-split sync
+    def _device_row_mask(self):
+        """(N,) f32 0/1 in-bag indicator from the learner's permutation
+        buffer, or None when every row is in the bag."""
+        if self.bag_buffer is None or self.bag_count >= self.num_data:
+            return None
+        buf = jnp.asarray(self.bag_buffer)
+        sel = (jnp.arange(buf.shape[0]) < self.bag_count)
+        mask = jnp.zeros((buf.shape[0],), jnp.float32).at[buf].set(
+            sel.astype(jnp.float32), mode="drop")
+        return mask[:self.num_data]
+
+    def _device_gradients(self):
+        """(grad (K,N), hess (K,N), per-class init biases) for the
+        device path; RF overrides with its fixed targets."""
+        init_scores = [self.boost_from_average(k)
+                       for k in range(self.num_model)]
+        grad, hess = self.objective.get_gradients(self.train_score)
+        if grad.ndim == 1:
+            grad, hess = grad[None, :], hess[None, :]
+        grad, hess = self._adjust_gradients(grad, hess)
+        return grad, hess, init_scores
+
     def _train_one_iter_device(self) -> bool:
         if self._device_stop:
             return True
-        init_score = self.boost_from_average(0)
-        grad, hess = self.objective.get_gradients(self.train_score)
-        if grad.ndim > 1:
-            grad, hess = grad[0], hess[0]
-        mask = self.learner._feature_mask()
-        score, rec_i, rec_f, nl, root_val, waves = \
-            self._grower.grow_one_iter(
-                self.train_score[0], grad, hess, mask,
-                self.shrinkage_rate * self._tree_multiplier())
-        self.train_score = score[None, :]
-        self._wave_handles.append(waves)   # async scalars; bench sums them
-        self.models.append(_PendingTree(
-            rec_i, rec_f, nl, root_val,
-            self.shrinkage_rate * self._tree_multiplier(), init_score))
+        grad, hess, init_scores = self._device_gradients()
+        self.bagging(self.iter)
+        grad, hess = self._post_bagging_adjust(grad, hess)
+        row_mask = self._device_row_mask()
+        shrink = self.shrinkage_rate * self._tree_multiplier()
+        nls = []
+        first_iter = len(self.models) < self.num_model
+        for k in range(self.num_model):
+            if not self.class_need_train[k]:
+                # fixed stump, host-path semantics (train_one_iter's
+                # stump branch): only the first iteration's stump
+                # carries the class's constant output
+                tree = Tree(2)
+                if first_iter:
+                    output = (self.objective.boost_from_score(k)
+                              if self.objective else 0.0)
+                    tree.leaf_value[0] = output
+                    if abs(output) > K_EPSILON:
+                        self.train_score = \
+                            self.train_score.at[k].add(output)
+                self.models.append(tree)
+                continue
+            # fresh feature_fraction draw per tree, like the host
+            # learner's per-train sampling (learner.py:248)
+            mask = self.learner._feature_mask()
+            score, rec_i, rec_f, rec_c, nl, root_val, waves = \
+                self._grower.grow_one_iter(
+                    self.train_score[k], grad[k], hess[k], mask, shrink,
+                    row_mask)
+            self.train_score = self.train_score.at[k].set(score)
+            self._wave_handles.append(waves)
+            self.models.append(_PendingTree(
+                rec_i, rec_f, rec_c, nl, root_val, shrink,
+                init_scores[k]))
+            nls.append(nl)
         self.iter += 1
         # stump check: inspect num_leaves with a 4-iteration lag — the
-        # handle's async copy has long landed by then (each iteration is
-        # hundreds of ms of device work), so this never blocks the host
-        # and never stalls the dispatch pipeline, yet training stops at
-        # most 4 wasted dispatches after a stall (the reference checks
-        # every iteration, gbdt.cpp:412)
-        self._nl_queue.append(nl)
+        # handles' async copies have long landed by then (each iteration
+        # is hundreds of ms of device work), so this never blocks the
+        # host and never stalls the dispatch pipeline, yet training
+        # stops at most 4 wasted dispatches after a stall (the reference
+        # checks every iteration, gbdt.cpp:412)
+        self._nl_queue.append(nls)
         if len(self._nl_queue) > 4:
             old = self._nl_queue.pop(0)
-            if int(np.asarray(old)) <= 1:
+            if old and all(int(np.asarray(v)) <= 1 for v in old):
                 self._trim_device_stumps()
                 return True
         return False
@@ -375,18 +440,21 @@ class GBDT:
 
     def _flush_pending(self):
         """Materialize all device-grown trees into host ``Tree`` objects,
-        then drop trailing stumps: on the device path (no bagging/GOSS) a
-        stump means the gradients are a fixed point, so every later
-        dispatch is a deterministic repeat — trimming here (not just at
-        the lagged stall check) keeps predict()/save consistent with the
-        training scores no matter when training stopped."""
+        then drop trailing all-stump iterations: an iteration where
+        EVERY class produced a stump is exactly the host path's
+        should_continue=False stop condition (train_one_iter), so the
+        device path trims those iterations here (not just at the lagged
+        stall check) to keep predict()/save consistent with the training
+        scores no matter when training stopped."""
         for i, m in enumerate(self.models):
             if isinstance(m, _PendingTree):
                 self.models[i] = m.materialize(self.train_set, self.config)
         if self._grower is not None:
-            while (len(self.models) > self.num_model
-                   and self.models[-1].num_leaves <= 1):
-                del self.models[-1]
+            nm = max(self.num_model, 1)
+            while (len(self.models) > nm
+                   and all(t.num_leaves <= 1
+                           for t in self.models[-nm:])):
+                del self.models[-nm:]
                 self.iter -= 1
                 self._device_stop = True
 

@@ -13,6 +13,7 @@ class RF(GBDT):
     """Random forest: fixed targets (-label / -onehot), unit hessians, no
     shrinkage, bagging mandatory, averaged output (rf.hpp:18-207)."""
 
+
     def __init__(self, config):
         super().__init__(config)
         self.average_output = True
@@ -38,9 +39,17 @@ class RF(GBDT):
     def boost_from_average(self, class_id):
         return 0.0
 
+    def _device_gradients(self):
+        return self._rf_grad, self._rf_hess, [0.0] * self.num_model
+
+    def _tree_multiplier(self) -> float:
+        return 1.0
+
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
         if gradients is not None or hessians is not None:
             raise LightGBMError("RF mode does not support custom objectives")
+        if self._grower is not None:
+            return self._train_one_iter_device()
         self.bagging(self.iter)
         should_continue = False
         for k in range(self.num_model):
@@ -82,6 +91,8 @@ class RF(GBDT):
 
     def eval_valid(self):
         out = []
+        if self._grower is not None:
+            self._catch_up_valid_scores()
         for v in self.valid_sets:
             score = self._averaged(np.asarray(v.score, np.float64))
             for m in v.metrics:

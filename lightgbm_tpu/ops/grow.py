@@ -35,9 +35,11 @@ The TPU-native formulation is **dense**:
   copied to host asynchronously and replayed into ``Tree`` objects lazily.
 
 Supports: numerical features, missing-value routing (None/Zero/NaN),
-feature_fraction masks, L1/L2/max_delta_step.  Not yet routed here
-(handled by the host learner): categorical splits, monotone constraints,
-forced splits, renew-tree-output objectives, multiclass, bagging/GOSS.
+categorical optimal splits (the winning category set travels as an
+8-word bin bitset), feature_fraction masks, bagging/GOSS via a 0/1
+row-mask column, multiclass (one dispatch per class),
+L1/L2/max_delta_step, DART/RF (driven from boosting/).  Still host-only:
+monotone constraints, forced splits, renew-tree-output objectives.
 """
 
 from __future__ import annotations
@@ -56,7 +58,8 @@ from .split import (F_DEFAULT_LEFT, F_FEATURE, F_GAIN, F_IS_CAT, F_LEFT_C,
 
 # rows per histogram chunk: large chunks amortize MXU ramp-up; the
 # per-chunk one-hot (CH, G, NB) bf16 stays fusable into the dot operand
-_CHUNK = 32768
+import os as _os
+_CHUNK = int(_os.environ.get("LGBM_TPU_CHUNK", 32768))
 
 # record field layout (host replay reads these)
 REC_I_FIELDS = 5    # leaf, right, feature, threshold, default_left
@@ -129,8 +132,23 @@ class DeviceGrower:
         # W=40 at 5 cols = 200 columns ~2x slower per wave: two tiles.)
         self.wave_width = min(126 // self.hist_cols,
                               max(self.num_leaves - 1, 1))
+        # Pallas wave-histogram kernel for the full-width stage (VMEM
+        # one-hot tiles, see ops/hist_pallas.py).  auto = on for real
+        # TPU; einsum keeps the XLA formulation; interpret runs the
+        # kernel in interpreter mode (CPU tests).
+        mode = str(getattr(config, "hist_kernel", "auto")
+                   or "auto").lower()
+        self.pallas_interpret = mode == "interpret"
+        # v1 of the Pallas kernel measured 2x slower than the einsum
+        # (108.9 vs 53.9 ms/tree, 1M-row quick bench) - grid-step and
+        # block-layout overheads dominate at ch<=1024 VMEM budgets - so
+        # auto stays on the einsum until the kernel beats it
+        self.use_pallas = mode in ("pallas", "interpret")
         self.lr = float(config.learning_rate)
-        self._grow = jax.jit(self._grow_impl)
+        self._grow = jax.jit(functools.partial(self._grow_impl,
+                                               with_mask=False))
+        self._grow_masked = jax.jit(functools.partial(self._grow_impl,
+                                                      with_mask=True))
 
     # ------------------------------------------------------------------
     # wave histogram: one dense pass for up to W pending leaves
@@ -148,6 +166,21 @@ class DeviceGrower:
         g, nb = self.num_groups, self.nb
         w = pending.shape[0]
         k = self.hist_cols
+        if self.use_pallas and w == self.wave_width:
+            # full-width stage: MXU cost is tile-bound regardless of W,
+            # so the VMEM-resident kernel wins; narrow early stages stay
+            # on the einsum (XLA lowers small-N contractions cheaper)
+            from .hist_pallas import wave_hist_pallas
+            out = wave_hist_pallas(binned, leaf_id, ghk, pending,
+                                   g=g, nb=nb, k=k, w=w,
+                                   interpret=self.pallas_interpret)
+            h = out.reshape(g, nb, k, w).transpose(3, 0, 1, 2) \
+                .reshape(w, self.num_slots, k)
+            if k == 5:
+                return jnp.stack([h[..., 0] + h[..., 1],
+                                  h[..., 2] + h[..., 3],
+                                  h[..., 4]], axis=-1)
+            return h
         ch = _CHUNK
         n_chunks = self.n_pad // ch
         binned_c = binned.reshape(n_chunks, ch, g)
@@ -192,7 +225,7 @@ class DeviceGrower:
 
     # ------------------------------------------------------------------
     def _grow_impl(self, binned, binned_t, score, grad, hess, feature_mask,
-                   lr):
+                   lr, row_mask, *, with_mask):
         """One boosting iteration on device.  Returns (new_score, rec_i
         (L-1,5) i32, rec_f (L-1,9) f32, num_leaves i32, root_value f32).
         ``lr`` is traced so callbacks may reset the learning rate without
@@ -206,8 +239,15 @@ class DeviceGrower:
 
         grad = jnp.pad(grad, (0, npad_rows))
         hess = jnp.pad(hess, (0, npad_rows))
-        one = jnp.where(jnp.arange(n) < self.num_data, 1.0, 0.0
-                        ).astype(jnp.bfloat16)
+        one_f = jnp.where(jnp.arange(n) < self.num_data, 1.0, 0.0)
+        if with_mask:
+            # bagging/GOSS: 0/1 in-bag indicator. Out-of-bag rows drop out
+            # of histograms and counts (their grad/hess are already zeroed
+            # by the caller) but still get leaf-routed, so the score
+            # update reaches them - the reference's OOB traversal update
+            # (gbdt.cpp:451-471) falls out for free.
+            one_f = one_f * jnp.pad(row_mask, (0, npad_rows))
+        one = one_f.astype(jnp.bfloat16)
         ghi = grad.astype(jnp.bfloat16)
         hhi = hess.astype(jnp.bfloat16)
         if self.hist_cols == 5:
@@ -228,11 +268,13 @@ class DeviceGrower:
             value: jnp.ndarray          # (L+1,) f32
             depth: jnp.ndarray          # (L+1,) i32
             best: jnp.ndarray           # (L+1, 13) f32, gain NEG_INF if none
+            bestc: jnp.ndarray          # (L+1, 256) bool cat membership
             nl: jnp.ndarray             # i32 leaves so far
             waves: jnp.ndarray          # i32 wave count (profiling)
             done: jnp.ndarray           # bool
             rec_i: jnp.ndarray          # (L, 5) i32   (last row = junk)
             rec_f: jnp.ndarray          # (L, 9) f32   (last row = junk)
+            rec_c: jnp.ndarray          # (L, 8) i32   cat bin bitsets
             p_parent: jnp.ndarray       # (W,) i32  parent slot (-1 empty)
             p_small: jnp.ndarray        # (W,) i32  leaf whose hist is fresh
             p_large: jnp.ndarray        # (W,) i32  sibling (subtraction)
@@ -249,11 +291,13 @@ class DeviceGrower:
             value=jnp.zeros((L + 1,), jnp.float32),
             depth=jnp.zeros((L + 1,), jnp.int32),
             best=neg,
+            bestc=jnp.zeros((L + 1, 256), bool),
             nl=jnp.asarray(1, jnp.int32),
             waves=jnp.asarray(0, jnp.int32),
             done=jnp.asarray(False),
             rec_i=jnp.full((L, REC_I_FIELDS), -1, jnp.int32),
             rec_f=jnp.zeros((L, REC_F_FIELDS), jnp.float32),
+            rec_c=jnp.zeros((L, 8), jnp.int32),
             p_parent=jnp.full((W0,), -1, jnp.int32),
             p_small=jnp.concatenate([jnp.zeros(1, jnp.int32),
                                      jnp.full((W0 - 1,), -1, jnp.int32)])
@@ -261,18 +305,21 @@ class DeviceGrower:
             p_large=jnp.full((W0,), -1, jnp.int32),
         )
 
+        has_cat = bool(np.asarray(
+            self.dataset.f_is_categorical).any())
         find_one = functools.partial(find_best_split_impl, meta=self.meta,
-                                     hp=self.hyper, has_cat=False)
+                                     hp=self.hyper, has_cat=has_cat)
 
         def evaluate(hists, totals, ids, depths, feature_mask):
-            """vmapped find-best over fresh leaves; gated by splittability."""
+            """vmapped find-best over fresh leaves; gated by splittability.
+            Returns (packed (B,13), cat_member (B,256) bool)."""
             cons = jnp.asarray([-jnp.inf, jnp.inf], jnp.float32)
-            packed, _ = jax.vmap(
+            packed, catm = jax.vmap(
                 lambda h, t: find_one(h, t, cons, feature_mask))(hists,
                                                                  totals)
             ok = self._splittable(totals, depths) & (ids >= 0)
             gain = jnp.where(ok, packed[:, F_GAIN], NEG_INF)
-            return packed.at[:, F_GAIN].set(gain)
+            return packed.at[:, F_GAIN].set(gain), catm
 
         def make_wave(Ws: int):
           def wave(st: _S) -> _S:
@@ -311,11 +358,13 @@ class DeviceGrower:
                                    jnp.where(lg_ok, st.p_large, -1)])
             hists2 = jnp.concatenate([fresh, large])
             idc = jnp.clip(ids, 0, L - 1)
-            packed = evaluate(hists2, total[idc], ids, st.depth[idc],
-                              feature_mask)
+            packed, catm = evaluate(hists2, total[idc], ids,
+                                    st.depth[idc], feature_mask)
             safe = jnp.where(ids >= 0, ids, L)
             best = st.best.at[safe].set(
                 jnp.where((ids >= 0)[:, None], packed, st.best[safe]))
+            bestc = st.bestc.at[safe].set(
+                jnp.where((ids >= 0)[:, None], catm, st.bestc[safe]))
 
             # 4. select up to Ws best-gain splits within budget
             gains = best[:L, F_GAIN]
@@ -359,6 +408,26 @@ class DeviceGrower:
             goes_left = jnp.where(is_default, def_left[:, None],
                                   jnp.where(is_na, dl[:, None],
                                             bin_ <= thr[:, None]))
+            if has_cat:
+                # categorical routing: left iff the decoded bin is in the
+                # winning category set (partition.py:49 semantics); the
+                # (W,256) membership is packed into 8 x i32 words and the
+                # per-row word picked with an 8-way select chain (a
+                # table gather here measured far slower on TPU)
+                cm = bestc[jnp.clip(lsel, 0, L)]            # (W, 256)
+                cmw = jnp.sum(
+                    cm.reshape(Ws, 8, 32).astype(jnp.int32)
+                    << jnp.arange(32, dtype=jnp.int32)[None, None, :],
+                    axis=-1)                                # (W, 8)
+                widx = bin_ >> 5
+                bit = bin_ & 31
+                wv = jnp.zeros_like(bin_)
+                for j in range(8):
+                    wv = wv + jnp.where(widx == j, cmw[:, j:j + 1], 0)
+                left_cat = ((wv >> bit) & 1) == 1
+                is_cat_w = vecs[:, F_IS_CAT] > 0.5
+                goes_left = jnp.where(is_cat_w[:, None], left_cat,
+                                      goes_left)
             mask = (sel[:, None] & (st.leaf_id[None, :] == lsel[:, None])
                     & ~goes_left)
             upd = jnp.sum(mask * (r_ids - lsel)[:, None], axis=0,
@@ -400,6 +469,11 @@ class DeviceGrower:
                 jnp.where(sel[:, None], new_ri, st.rec_i[ridx]))
             rec_f = st.rec_f.at[ridx].set(
                 jnp.where(sel[:, None], new_rf, st.rec_f[ridx]))
+            if has_cat:
+                rec_c = st.rec_c.at[ridx].set(
+                    jnp.where(sel[:, None], cmw, st.rec_c[ridx]))
+            else:
+                rec_c = st.rec_c
             # pending for the next wave
             small_left = vecs[:, F_LEFT_C] <= vecs[:, F_RIGHT_C]
             pp = jnp.where(sel, lsel, -1)
@@ -407,9 +481,10 @@ class DeviceGrower:
             pl = jnp.where(sel, jnp.where(small_left, r_ids, lsel), -1)
 
             return _S(leaf_id=leaf_id, hist=hist, total=total, value=value,
-                      depth=depth, best=best, nl=st.nl + napply,
+                      depth=depth, best=best, bestc=bestc,
+                      nl=st.nl + napply,
                       waves=st.waves + 1, done=napply == 0,
-                      rec_i=rec_i, rec_f=rec_f,
+                      rec_i=rec_i, rec_f=rec_f, rec_c=rec_c,
                       p_parent=pp, p_small=ps, p_large=pl)
           return wave
 
@@ -456,18 +531,27 @@ class DeviceGrower:
         new_score = score + (upd[:, 0] + upd[:, 1])[:self.num_data]
 
         return (new_score, final.rec_i[:max(L - 1, 1)],
-                final.rec_f[:max(L - 1, 1)], final.nl, final.value[0],
+                final.rec_f[:max(L - 1, 1)],
+                final.rec_c[:max(L - 1, 1)], final.nl, final.value[0],
                 final.waves)
 
     # ------------------------------------------------------------------
-    def grow_one_iter(self, score, grad, hess, feature_mask, lr=None):
+    def grow_one_iter(self, score, grad, hess, feature_mask, lr=None,
+                      row_mask=None):
         """Dispatch one boosting iteration; returns device handles
-        (new_score, rec_i, rec_f, num_leaves, root_value, num_waves)
-        without blocking."""
+        (new_score, rec_i, rec_f, rec_c, num_leaves, root_value,
+        num_waves) without blocking.  ``row_mask`` is an optional (N,)
+        f32 0/1 in-bag indicator (bagging / GOSS)."""
         if lr is None:
             lr = self.lr
-        return self._grow(self.binned, self.binned_t, score, grad, hess,
-                          feature_mask, jnp.asarray(lr, jnp.float32))
+        if row_mask is None:
+            return self._grow(self.binned, self.binned_t, score, grad,
+                              hess, feature_mask,
+                              jnp.asarray(lr, jnp.float32),
+                              jnp.zeros((0,), jnp.float32))
+        return self._grow_masked(self.binned, self.binned_t, score, grad,
+                                 hess, feature_mask,
+                                 jnp.asarray(lr, jnp.float32), row_mask)
 
 
     # ------------------------------------------------------------------
@@ -567,18 +651,14 @@ class DeviceGrower:
 
 def device_growth_eligible(config, dataset, objective, num_model) -> bool:
     """Whether the dense device grower covers this training configuration.
-    Anything it can't do falls back to the host-driven learner."""
-    if num_model != 1:
-        return False
+    Anything it can't do falls back to the host-driven learner.
+    Multiclass runs one grow dispatch per class; bagging/GOSS route a
+    0/1 row mask into the wave histogram's count column."""
     if dataset.num_groups == 0 or dataset.num_features == 0:
-        return False
-    if np.asarray(dataset.f_is_categorical).any():
         return False
     if np.asarray(dataset.monotone_constraints).any():
         return False
     if objective is None or objective.is_renew_tree_output:
-        return False
-    if config.bagging_fraction < 1.0 and config.bagging_freq > 0:
         return False
     if getattr(config, "forcedsplits_filename", ""):
         return False

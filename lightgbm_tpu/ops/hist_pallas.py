@@ -1,0 +1,116 @@
+"""Pallas TPU kernel for the wave histogram (SURVEY §7: THE kernel).
+
+The XLA formulation in ``ops/grow.py`` builds a per-chunk one-hot of the
+bin codes and contracts it with the leaf-mask x stat columns on the MXU.
+Measured at ~37% of MXU peak — the one-hot operand's generation/layout
+inside the fused dot dominates.  This kernel owns the whole pipeline in
+VMEM instead (the analog of the reference's workgroup-local OpenCL
+histograms, ``src/treelearner/ocl/histogram256.cl:343-360``, minus the
+atomics TPU doesn't have):
+
+* grid over row chunks; per step the chunk's bin codes (CH, G) u8,
+  leaf ids (CH, 1) i32 and stat columns (CH, K) bf16 are DMA'd in;
+* the leaf mask and the B = K*W stat-column matrix are built on the VPU;
+* groups are processed in PAIRS so each one-hot tile is (CH, 128) —
+  a full MXU tile — and contracted with the (CH, 128) stat matrix:
+  out[pair] += one_hotᵀ @ bmat, accumulated in a VMEM-resident
+  (G*NB, 128) f32 output revisited across all grid steps.
+
+Layout: B columns are K-major (column k*W + w holds stat k of wave slot
+w), so no 3D intermediates touch the minor-most dimension.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+
+
+def _kernel(binned_ref, leaf_ref, gh_ref, pend_ref, out_ref, *,
+            ch: int, g: int, nb: int, k: int, w: int):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    leaf = leaf_ref[:]                                  # (CH, 1) i32
+    pend = pend_ref[0:1, :w]                            # (1, W) i32
+    lm = (leaf == pend).astype(jnp.bfloat16)            # (CH, W)
+    gh = gh_ref[:]                                      # (CH, K) bf16
+    # K-major stat matrix, zero-padded to the 128-lane tile
+    cols = [lm * gh[:, kk:kk + 1] for kk in range(k)]
+    pad = _LANES - k * w
+    if pad:
+        cols.append(jnp.zeros((ch, pad), jnp.bfloat16))
+    bmat = jnp.concatenate(cols, axis=1)                # (CH, 128)
+
+    bins = binned_ref[:].astype(jnp.int32)              # (CH, G)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (ch, nb), 1)
+    for g0 in range(0, g, 2):
+        if g0 + 1 < g:
+            # cast each comparison before the concat — Mosaic cannot
+            # bitcast i1 vregs through a concatenate
+            oh = jnp.concatenate(
+                [(bins[:, g0:g0 + 1] == iota).astype(jnp.bfloat16),
+                 (bins[:, g0 + 1:g0 + 2] == iota).astype(jnp.bfloat16)],
+                axis=1)                                 # (CH, 2*NB)
+        else:
+            oh = (bins[:, g0:g0 + 1] == iota).astype(jnp.bfloat16)
+        acc = jax.lax.dot_general(
+            oh, bmat, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (2*NB, 128)
+        r0 = g0 * nb
+        r1 = r0 + acc.shape[0]
+        out_ref[r0:r1, :] = out_ref[r0:r1, :] + acc
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("g", "nb", "k", "w", "ch",
+                                    "interpret"))
+def wave_hist_pallas(binned, leaf_id, ghk, pending, *, g: int, nb: int,
+                     k: int, w: int, ch: int = 1024,
+                     interpret: bool = False):
+    """(n_pad, G) u8 bins, (n_pad,) i32 leaf ids, (n_pad, K) bf16 stat
+    columns, (W,) i32 pending -> (G*NB, K, W) f32 histogram."""
+    n = binned.shape[0]
+    if n % ch:
+        raise ValueError(
+            f"pallas wave-histogram needs rows ({n}) divisible by its "
+            f"chunk ({ch}); pad rows to a multiple (LGBM_TPU_CHUNK must "
+            f"be a multiple of {ch} when using hist_kernel=pallas)")
+    assert k * w <= _LANES
+    grid = (n // ch,)
+    leaf2 = leaf_id.reshape(n, 1)
+    pend2 = pending.reshape(1, w)
+    out = pl.pallas_call(
+        functools.partial(_kernel, ch=ch, g=g, nb=nb, k=k, w=w),
+        out_shape=jax.ShapeDtypeStruct((g * nb, _LANES), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ch, g), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ch, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ch, ghk.shape[1]), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, w), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((g * nb, _LANES), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n * g * nb * _LANES,
+            bytes_accessed=n * (g + 4 + 2 * k) + g * nb * _LANES * 4,
+            transcendentals=0,
+        ),
+    )(binned, leaf2, ghk, pend2)
+    # (G*NB, 128) -> (G*NB, K, W) -> caller reshapes to (W, S, 3)
+    return out[:, :k * w].reshape(g * nb, k, w)

@@ -368,6 +368,14 @@ PARAM_SCHEMA: Sequence[Param] = (
        desc="alias-level switch for float64 accumulation on TPU", section="device"),
     _p("tpu_rows_per_block", int, 0, (),
        desc="rows per Pallas histogram grid block; 0 = auto", section="device"),
+    _p("hist_kernel", str, "auto", (),
+       check="auto/pallas/einsum/interpret",
+       desc="wave-histogram implementation for the device grower: "
+            "einsum = XLA one-hot matmul (default; fastest measured), "
+            "pallas = VMEM-resident Pallas TPU kernel (ops/hist_pallas.py, "
+            "experimental: currently slower than the einsum), interpret = "
+            "Pallas interpreter mode (CPU testing), auto = einsum",
+       section="device"),
     _p("device_growth", str, "auto", ("tpu_device_growth",),
        check="auto/on/off",
        desc="fully on-device wave-synchronized tree growth (one dispatch "

@@ -41,6 +41,22 @@ def reg_data():
     return x, y
 
 
+
+def _assert_trees_close(th, td, max_flips=2):
+    """Identical up to near-tie threshold flips: host and device
+    accumulate f32 histograms in different orders (per-leaf scan vs wave
+    matmul), so a handful of one-bin threshold moves on equal-gain ties
+    are legitimate even under gpu_use_dp."""
+    assert th.num_leaves == td.num_leaves
+    sh, sd = set(_split_set(th)), set(_split_set(td))
+    only_h = sorted(sh - sd)
+    only_d = sorted(sd - sh)
+    assert len(only_h) == len(only_d) <= max_flips, (only_h, only_d)
+    for (fh, bh_, ch), (fd, bd_, cd) in zip(only_h, only_d):
+        assert fh == fd and ch == cd and abs(bh_ - bd_) <= 2, \
+            (only_h, only_d)
+
+
 def test_device_tree_matches_host(reg_data):
     """With a generous leaf budget and gpu_use_dp (f32-exact histogram
     accumulation) both paths should produce the same split set (wave
@@ -228,7 +244,8 @@ def test_eligibility_gates():
     rng = np.random.default_rng(1)
     x = rng.standard_normal((300, 4)).astype(np.float32)
     y = rng.standard_normal(300).astype(np.float32)
-    # bagging disables the device path
+    # bagging and multiclass are now device-eligible; renew objectives
+    # (L1-style leaf refits) still fall back to the host learner
     cfg = Config({"objective": "regression", "bagging_fraction": 0.5,
                   "bagging_freq": 1})
     ds = BinnedDataset.construct_from_matrix(x, cfg)
@@ -237,7 +254,191 @@ def test_eligibility_gates():
     obj.init(ds.metadata or __import__(
         "lightgbm_tpu.data.dataset", fromlist=["Metadata"]).Metadata(300),
         300)
-    assert not device_growth_eligible(cfg, ds, obj, 1)
+    assert device_growth_eligible(cfg, ds, obj, 1)
     cfg2 = Config({"objective": "regression"})
     assert device_growth_eligible(cfg2, ds, obj, 1)
-    assert not device_growth_eligible(cfg2, ds, obj, 3)
+    assert device_growth_eligible(cfg2, ds, obj, 3)
+    cfg3 = Config({"objective": "regression_l1"})
+    obj3 = create_objective(cfg3)
+    obj3.init(ds.metadata, 300)
+    assert not device_growth_eligible(cfg3, ds, obj3, 1)
+
+
+def test_pallas_hist_matches_einsum(reg_data):
+    """The Pallas wave-histogram kernel (interpret mode on CPU) must
+    agree with the XLA einsum formulation bin-for-bin."""
+    import jax.numpy as jnp
+    x, y = reg_data
+    params = {"objective": "regression", "num_leaves": 64,
+              "min_data_in_leaf": 50}
+    bd = _make(params, x, y, True)
+    grower = bd._grower
+    assert grower is not None
+    n = grower.n_pad
+    rng = np.random.default_rng(0)
+    leaf = jnp.asarray(rng.integers(0, 8, n).astype(np.int32))
+    g = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    h = jnp.asarray(rng.random(n).astype(np.float32))
+    one = jnp.ones((n,), jnp.bfloat16)
+    ghk = jnp.stack([g.astype(jnp.bfloat16), h.astype(jnp.bfloat16),
+                     one], 1)
+    pending = jnp.asarray(
+        np.concatenate([np.arange(6), [-1] * (grower.wave_width - 6)])
+        .astype(np.int32))
+    grower.use_pallas = False
+    ref = np.asarray(grower._wave_hist(grower.binned, leaf, ghk, pending))
+    grower.use_pallas = True
+    grower.pallas_interpret = True
+    got = np.asarray(grower._wave_hist(grower.binned, leaf, ghk, pending))
+    np.testing.assert_allclose(got, ref, atol=1e-3, rtol=1e-4)
+
+
+def test_device_bagging_matches_host(reg_data):
+    """Bagging routes a row mask into the device grower; with the same
+    seed both paths draw the same bag, so gpu_use_dp trees must match
+    split-for-split."""
+    x, y = reg_data
+    # num_leaves far above the natural stop (min_data_in_leaf halts
+    # growth first): wave batching only deviates from strict best-first
+    # under budget pressure (see grow.py module docstring)
+    params = {"objective": "regression", "num_leaves": 64,
+              "learning_rate": 0.1, "bagging_fraction": 0.6,
+              "bagging_freq": 1, "bagging_seed": 9, "gpu_use_dp": True,
+              "min_data_in_leaf": 60}
+    bh = _make(params, x, y, False)
+    bd = _make(params, x, y, True)
+    assert bd._grower is not None
+    for _ in range(3):
+        bh.train_one_iter()
+        bd.train_one_iter()
+    bd._flush_pending()
+    for th, td in zip(bh.models, bd.models):
+        _assert_trees_close(th, td)
+    np.testing.assert_allclose(bd.predict(x[:100]), bh.predict(x[:100]),
+                               atol=5e-3)
+
+
+def test_device_multiclass_matches_host():
+    rng = np.random.default_rng(11)
+    n = 3000
+    x = rng.standard_normal((n, 5)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0.5).astype(np.float32) \
+        + (x[:, 2] > 0.8) * 1.0
+    # min_gain_to_split suppresses noise splits (the exhausted class-2
+    # residual yields gains ~1e-5 where host/device f32 rounding
+    # legitimately disagrees about positivity)
+    params = {"objective": "multiclass", "num_class": 3,
+              "num_leaves": 64, "learning_rate": 0.1,
+              "gpu_use_dp": True, "min_data_in_leaf": 100,
+              "min_gain_to_split": 1e-3}
+    bh = _make(params, x, y, False)
+    bd = _make(params, x, y, True)
+    assert bd._grower is not None and bd.num_model == 3
+    # 3 iterations of exact tree equality; beyond that, accumulated f32
+    # score drift (~1e-6/iter) legitimately flips near-tie thresholds
+    for _ in range(3):
+        bh.train_one_iter()
+        bd.train_one_iter()
+    bd._flush_pending()
+    assert len(bd.models) == len(bh.models) == 9
+    for th, td in zip(bh.models, bd.models):
+        _assert_trees_close(th, td)
+    np.testing.assert_allclose(bd.predict(x[:100]), bh.predict(x[:100]),
+                               atol=5e-3)
+    # accuracy sanity
+    pred = np.argmax(bd.predict(x), axis=1)
+    assert (pred == y).mean() > 0.8
+
+
+def test_device_goss_matches_host(reg_data):
+    x, y = reg_data
+    params = {"objective": "regression", "boosting": "goss",
+              "num_leaves": 64, "learning_rate": 0.3,
+              "top_rate": 0.3, "other_rate": 0.2, "gpu_use_dp": True,
+              "min_data_in_leaf": 60}
+    bh = _make(params, x, y, False)
+    bd = _make(params, x, y, True)
+    assert bd._grower is not None
+    # train past the GOSS warm-up (1/lr = 3 iters) so sampling kicks in
+    for _ in range(6):
+        bh.train_one_iter()
+        bd.train_one_iter()
+    bd._flush_pending()
+    assert any(t.num_leaves > 1 for t in bd.models[3:])
+    for th, td in zip(bh.models, bd.models):
+        _assert_trees_close(th, td)
+    np.testing.assert_allclose(bd.predict(x[:100]), bh.predict(x[:100]),
+                               atol=5e-3)
+
+
+def test_device_categorical_matches_host():
+    """Categorical optimal splits route through the device grower: the
+    winning category set is carried as an 8-word bin bitset and replayed
+    into Tree.split_categorical."""
+    rng = np.random.default_rng(13)
+    n = 4000
+    cat = rng.integers(0, 12, n)
+    x = np.column_stack([
+        cat.astype(np.float32),
+        rng.standard_normal(n).astype(np.float32),
+        rng.standard_normal(n).astype(np.float32)])
+    effect = np.asarray([2.0, -1.0, 0.5, 3.0, -2.0, 0.0,
+                         1.5, -0.5, 2.5, -1.5, 0.7, -2.5])
+    y = (effect[cat] + x[:, 1] + 0.1 * rng.standard_normal(n)) \
+        .astype(np.float32)
+    params = {"objective": "regression", "num_leaves": 64,
+              "learning_rate": 0.1, "min_data_in_leaf": 60,
+              "gpu_use_dp": True, "min_gain_to_split": 1e-3,
+              "categorical_feature": [0]}
+    cfg_h = Config({**params, "device_growth": "off"})
+    cfg_d = Config({**params, "device_growth": "on"})
+    from lightgbm_tpu.boosting import create_boosting
+    out = {}
+    for tag, cfg in (("h", cfg_h), ("d", cfg_d)):
+        ds = BinnedDataset.construct_from_matrix(x, cfg, categorical=[0])
+        ds.metadata.set_label(y)
+        bst = create_boosting(cfg)
+        bst.init_train(ds)
+        for _ in range(3):
+            bst.train_one_iter()
+        bst._flush_pending()
+        out[tag] = bst
+    assert out["d"]._grower is not None
+    assert out["h"]._grower is None
+    for th, td in zip(out["h"].models, out["d"].models):
+        _assert_trees_close(th, td)
+    # at least one categorical split must exist and round-trip
+    assert any(t.num_cat > 0 for t in out["d"].models)
+    np.testing.assert_allclose(out["d"].predict(x[:200]),
+                               out["h"].predict(x[:200]), atol=5e-3)
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    loaded = GBDT.load_model_from_string(out["d"].model_to_string())
+    np.testing.assert_allclose(loaded.predict(x[:200], raw_score=True),
+                               out["d"].predict(x[:200], raw_score=True),
+                               atol=1e-6)
+
+
+@pytest.mark.parametrize("boosting", ["dart", "rf"])
+def test_device_dart_rf_match_host(reg_data, boosting):
+    """DART and RF route through the device grower (DART flushes pending
+    records before re-scaling dropped trees; RF feeds its fixed targets
+    through the gradient hook)."""
+    x, y = reg_data
+    params = {"objective": "regression", "boosting": boosting,
+              "num_leaves": 64, "learning_rate": 0.1,
+              "min_data_in_leaf": 60, "gpu_use_dp": True,
+              "min_gain_to_split": 1e-3,
+              "bagging_fraction": 0.7, "bagging_freq": 1,
+              "drop_seed": 4}
+    bh = _make(params, x, y, False)
+    bd = _make(params, x, y, True)
+    assert bd._grower is not None
+    for _ in range(4):
+        bh.train_one_iter()
+        bd.train_one_iter()
+    bd._flush_pending()
+    assert len(bd.models) == len(bh.models)
+    for th, td in zip(bh.models, bd.models):
+        _assert_trees_close(th, td)
+    np.testing.assert_allclose(bd.predict(x[:100]), bh.predict(x[:100]),
+                               atol=5e-3)
