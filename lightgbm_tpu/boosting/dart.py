@@ -65,11 +65,25 @@ class DART(GBDT):
                                 and len(self.drop_index) >= cfg.max_drop):
                             break
         # device path: dropped trees are re-scaled in place, so pending
-        # device records must be materialized first — but only when
-        # something was actually dropped (flushing blocks the dispatch
-        # pipeline; skip_drop iterations stay fully async)
+        # device records must be materialized first — and the valid
+        # scores caught up NOW, because _normalize edits them with
+        # per-tree deltas that are only sound once every prior tree
+        # actually reached them.  Both happen only when something was
+        # dropped: skip_drop iterations stay fully async (flushing or
+        # catching up every iteration would block the one-dispatch
+        # pipeline the device grower is built around).
         if self.drop_index and self._grower is not None:
-            self._flush_pending()
+            if self.valid_sets:
+                self._catch_up_valid_scores()
+            else:
+                self._flush_pending()
+            if self._device_stop:
+                # the flush trimmed trailing stalled iterations (training
+                # is over): drop_index was drawn over the pre-trim range
+                # and may index past the shrunk model list — and there is
+                # nothing left to train on anyway
+                self.drop_index = []
+                return
         # subtract dropped trees from the training score
         for i in self.drop_index:
             for k in range(self.num_model):
@@ -85,11 +99,7 @@ class DART(GBDT):
                                    / (cfg.learning_rate + k_drop))
 
     def _normalize(self):
-        # device path: normalize edits valid scores with per-tree deltas,
-        # which is only sound once every prior tree actually reached the
-        # valid scores (they are caught up lazily)
-        if self._grower is not None and self.valid_sets:
-            self._catch_up_valid_scores()
+        # valid scores were caught up in _dropping_trees (device path)
         cfg = self.config
         k = float(len(self.drop_index))
         for i in self.drop_index:
@@ -127,6 +137,21 @@ class DART(GBDT):
         self._dropping_trees()
         ret = super().train_one_iter(gradients, hessians)
         if ret:
+            # training stopped before _normalize could restore the
+            # dropped trees: undo the drop (re-negate back to the
+            # original values and re-add to the training score) so the
+            # stored model is consistent with predict().  The reference
+            # leaves the trees sign-flipped here (dart.hpp:52-58 returns
+            # before Normalize) — a latent defect in a stopped-training
+            # edge case, deliberately not reproduced; the device path's
+            # retroactive stall trim would hit it on every DART stall.
+            for i in self.drop_index:
+                for k in range(self.num_model):
+                    tree = self.models[i * self.num_model + k]
+                    tree.apply_shrinkage(-1.0)
+                    self._add_tree_everywhere(tree, k, train=True,
+                                              valid=False)
+            self.drop_index = []
             return ret
         self._normalize()
         if not self.config.uniform_drop:

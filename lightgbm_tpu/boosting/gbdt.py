@@ -79,7 +79,7 @@ class _PendingTree:
             # GBDT.train_one_iter's stump branch
             tree.leaf_value[0] = 0.0
         else:
-            from ..tree.tree import construct_bitset
+            from ..tree.tree import categorical_bitsets
             rec_i = np.asarray(self.rec_i)
             rec_f = np.asarray(self.rec_f)
             rec_c = np.asarray(self.rec_c)
@@ -96,15 +96,11 @@ class _PendingTree:
                     member_bins = [
                         b for b in range(min(mapper.num_bin, 256))
                         if (words[b >> 5] >> (b & 31)) & 1]
-                    bitset_inner = construct_bitset(member_bins)
-                    cats = [int(mapper.bin_2_categorical[b])
-                            for b in member_bins
-                            if b < len(mapper.bin_2_categorical)
-                            and mapper.bin_2_categorical[b] >= 0]
+                    bitset_inner, bitset = categorical_bitsets(
+                        mapper, member_bins)
                     tree.split_categorical(
-                        leaf, f, real_f, bitset_inner,
-                        construct_bitset(cats), lout, rout, int(lc),
-                        int(rc), gain, missing)
+                        leaf, f, real_f, bitset_inner, bitset, lout,
+                        rout, int(lc), int(rc), gain, missing)
                 else:
                     tree.split(leaf, f, real_f, thr,
                                mapper.bin_to_value(thr), lout, rout,

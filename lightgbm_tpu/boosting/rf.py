@@ -42,9 +42,6 @@ class RF(GBDT):
     def _device_gradients(self):
         return self._rf_grad, self._rf_hess, [0.0] * self.num_model
 
-    def _tree_multiplier(self) -> float:
-        return 1.0
-
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
         if gradients is not None or hessians is not None:
             raise LightGBMError("RF mode does not support custom objectives")

@@ -19,8 +19,12 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-# values with |v| <= kZeroThreshold are "zero" (reference bin.h kZeroThreshold)
-K_ZERO_THRESHOLD = 1e-35
+# values with |v| <= kZeroThreshold are "zero".  The reference writes the
+# literal as 1e-35f (meta.h:40) — a float32 constant promoted to double —
+# so the working threshold is float32(1e-35), not double 1e-35; matching
+# it exactly keeps the -kZeroThreshold/+kZeroThreshold bin bounds
+# bit-identical (tests/test_parity.py)
+K_ZERO_THRESHOLD = float(np.float32(1e-35))
 
 MISSING_NONE = "none"
 MISSING_ZERO = "zero"

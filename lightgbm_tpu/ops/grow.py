@@ -555,7 +555,7 @@ class DeviceGrower:
 
 
     # ------------------------------------------------------------------
-    def profile_phases(self, grad, hess, reps: int = 3) -> dict:
+    def profile_phases(self, grad, hess, reps: int = 20) -> dict:
         """Honest per-phase attribution for one wave (bench --profile).
 
         The production grower runs the whole tree inside one
@@ -628,8 +628,16 @@ class DeviceGrower:
                            .astype(np.float32))
         score = jnp.zeros((n,), jnp.float32)
 
+        # dispatch-latency floor: an empty jitted program measured the
+        # same way; subtracted from every phase so tunnel round-trip
+        # latency doesn't masquerade as device time
+        @jax.jit
+        def p_null(x):
+            return x + 1.0
+
         out = {}
         cases = {
+            "null_dispatch": lambda: p_null(score[:8]),
             "wave_hist": lambda: p_hist(self.binned, leaf_id, grad, hess,
                                         pending),
             "find_best": None,   # filled after hist exists
@@ -646,6 +654,9 @@ class DeviceGrower:
                 r = fn()
             jax.block_until_ready(r)
             out[name] = round((_time.perf_counter() - t0) / reps * 1e3, 2)
+        floor = out.pop("null_dispatch")
+        out = {k: round(max(v - floor, 0.0), 2) for k, v in out.items()}
+        out["dispatch_floor"] = floor
         return out
 
 

@@ -46,7 +46,7 @@ from ..ops.split import (F_DEFAULT_LEFT, F_FEATURE, F_GAIN, F_IS_CAT,
                          F_RIGHT_C, F_RIGHT_G, F_RIGHT_H, F_RIGHT_OUT,
                          F_THRESHOLD, SplitContext)
 from ..utils.log import TRAIN_TIMER, log_debug, log_warning
-from .tree import Tree, construct_bitset
+from .tree import Tree, categorical_bitsets
 
 
 class SplitParams(NamedTuple):
@@ -377,11 +377,7 @@ class SerialTreeLearner:
         if is_cat:
             member_bins = [int(bb) for bb in np.nonzero(sp.cat_member)[0]
                            if bb < nb]
-            bitset_inner = construct_bitset(member_bins)
-            cats = [int(mapper.bin_2_categorical[bb]) for bb in member_bins
-                    if bb < len(mapper.bin_2_categorical)
-                    and mapper.bin_2_categorical[bb] >= 0]
-            bitset = construct_bitset(cats)
+            bitset_inner, bitset = categorical_bitsets(mapper, member_bins)
             right_leaf = tree.split_categorical(
                 leaf, f, real_f, bitset_inner, bitset, left_out, right_out,
                 int(left_sum[2]), int(right_sum[2]), gain, sp.missing)

@@ -41,6 +41,20 @@ def construct_bitset(values) -> List[int]:
     return words
 
 
+def categorical_bitsets(mapper, member_bins):
+    """(inner-bin bitset, raw-category bitset) for a categorical split whose
+    LEFT side is the given bin set.  Shared by the host learner and the
+    device grower's record replay so the subtle parts — the
+    ``bin_2_categorical[b] >= 0`` NaN-bin exclusion and the 256-bin cap —
+    live in exactly one place."""
+    member_bins = [int(b) for b in member_bins if int(b) < 256]
+    bitset_inner = construct_bitset(member_bins)
+    cats = [int(mapper.bin_2_categorical[b]) for b in member_bins
+            if b < len(mapper.bin_2_categorical)
+            and mapper.bin_2_categorical[b] >= 0]
+    return bitset_inner, construct_bitset(cats)
+
+
 def find_in_bitset(words, val: int) -> bool:
     i1 = val // 32
     if val < 0 or i1 >= len(words):

@@ -1,0 +1,49 @@
+// Dump reference BinMapper::FindBin outputs for parity fixtures.
+//
+// Reads cases from stdin:
+//   <case_name> <max_bin> <min_data_in_bin> <use_missing> <zero_as_missing> <n>
+//   v0 v1 ... v{n-1}
+// and prints one JSON object per case:
+//   {"name": ..., "num_bin": B, "missing_type": M,
+//    "upper_bounds": [...]}   (upper bound of bin i = BinToValue(i))
+//
+// Build (see scripts/make_parity_fixtures.py):
+//   g++ -O2 -std=c++11 -I /root/reference/include dump_ref_bins.cpp \
+//       -L .refbuild -l_lightgbm -o dump_ref_bins
+#include <LightGBM/bin.h>
+
+#include <cstdio>
+#include <string>
+#include <vector>
+
+int main() {
+  char name[256];
+  int max_bin, min_data_in_bin, use_missing, zero_as_missing, n;
+  while (std::scanf("%255s %d %d %d %d %d", name, &max_bin, &min_data_in_bin,
+                    &use_missing, &zero_as_missing, &n) == 6) {
+    std::vector<double> values(n);
+    for (int i = 0; i < n; ++i) std::scanf("%lf", &values[i]);
+    LightGBM::BinMapper mapper;
+    // min_split_data=0 and NumericalBin match DatasetLoader's call site
+    // (dataset_loader.cpp ConstructBinMappersFromTextData)
+    mapper.FindBin(values.data(), n, n, max_bin, min_data_in_bin, 0,
+                   LightGBM::BinType::NumericalBin, use_missing != 0,
+                   zero_as_missing != 0);
+    std::printf("{\"name\": \"%s\", \"num_bin\": %d, \"missing_type\": %d, "
+                "\"upper_bounds\": [",
+                name, mapper.num_bin(),
+                static_cast<int>(mapper.missing_type()));
+    for (int b = 0; b < mapper.num_bin(); ++b) {
+      double v = mapper.BinToValue(b);
+      if (v > 1e300 * 1e8) {
+        // the last numerical bin's upper bound is +inf; Python's json
+        // parser accepts the "Infinity" spelling, bare "inf" it does not
+        std::printf("%sInfinity", b ? ", " : "");
+      } else {
+        std::printf("%s%.17g", b ? ", " : "", v);
+      }
+    }
+    std::printf("]}\n");
+  }
+  return 0;
+}

@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""Generate reference-parity fixtures under tests/fixtures/.
+
+Drives the REFERENCE implementation's C API (``lib_lightgbm.so`` built
+from ``/root/reference`` — see ``src/c_api.cpp``) via ctypes to produce
+golden outputs this framework must reproduce:
+
+* ``ref_<name>.model.txt``   — v2 model text saved by the reference
+* ``ref_<name>.preds.txt``   — reference raw-score predictions on the
+                               first PRED_ROWS rows of the training data
+* ``ref_<name>.eval.json``   — reference train-metric curve
+* ``ref_bins.jsonl``         — BinMapper::FindBin outputs (via
+                               scripts/dump_ref_bins.cpp)
+* ``ours_binary.model.txt`` + ``ref_preds_on_ours.txt`` — OUR trained
+  model text and what the REFERENCE predicts after loading it (format
+  round-trip evidence, generated once; the test replays our side)
+
+Usage:  python scripts/make_parity_fixtures.py [--lib PATH]
+Requires the reference build (cmake + make in .refbuild) and the
+dump_ref_bins tool; see VERDICT r3 item 4 for the charter.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ctypes
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "tests"))
+
+import parity_data as pd  # noqa: E402
+
+FIXDIR = os.path.join(ROOT, "tests", "fixtures")
+
+DTYPE_F64 = 1
+PREDICT_RAW = 1
+
+
+class Ref:
+    """Minimal ctypes wrapper over the reference C API."""
+
+    def __init__(self, lib_path):
+        self.lib = ctypes.CDLL(lib_path)
+        self.lib.LGBM_GetLastError.restype = ctypes.c_char_p
+        # the fork changed LGBM_BoosterCreate to take a C++
+        # unordered_map (its consumer is src/test.cpp); ref_shim.so
+        # rebuilds the map from a plain param string
+        self.shim = ctypes.CDLL(os.path.join(
+            os.path.dirname(lib_path), "ref_shim.so"))
+
+    def _check(self, rc):
+        if rc != 0:
+            raise RuntimeError(self.lib.LGBM_GetLastError().decode())
+
+    def dataset(self, x, label, params=""):
+        x = np.ascontiguousarray(x, np.float64)
+        handle = ctypes.c_void_p()
+        self._check(self.lib.LGBM_DatasetCreateFromMat(
+            x.ctypes.data_as(ctypes.c_void_p), DTYPE_F64,
+            ctypes.c_int32(x.shape[0]), ctypes.c_int32(x.shape[1]),
+            ctypes.c_int(1), params.encode(), None,
+            ctypes.byref(handle)))
+        lab = np.ascontiguousarray(label, np.float32)
+        self._check(self.lib.LGBM_DatasetSetField(
+            handle, b"label", lab.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int(len(lab)), ctypes.c_int(0)))
+        return handle
+
+    def train(self, ds, params, iters):
+        bst = ctypes.c_void_p()
+        self._check(self.shim.Shim_BoosterCreate(ds, params.encode(),
+                                                 ctypes.byref(bst)))
+        fin = ctypes.c_int(0)
+        evals = []
+        for _ in range(iters):
+            self._check(self.lib.LGBM_BoosterUpdateOneIter(
+                bst, ctypes.byref(fin)))
+            out_len = ctypes.c_int(0)
+            buf = (ctypes.c_double * 8)()
+            self._check(self.lib.LGBM_BoosterGetEval(
+                bst, ctypes.c_int(0), ctypes.byref(out_len), buf))
+            evals.append([buf[i] for i in range(out_len.value)])
+            if fin.value:
+                break
+        return bst, evals
+
+    def save_to_string(self, bst):
+        out_len = ctypes.c_int64(0)
+        buf_len = 1 << 24
+        buf = ctypes.create_string_buffer(buf_len)
+        self._check(self.lib.LGBM_BoosterSaveModelToString(
+            bst, ctypes.c_int(0), ctypes.c_int(-1),
+            ctypes.c_int64(buf_len), ctypes.byref(out_len), buf))
+        return buf.value.decode()
+
+    def load_from_string(self, text):
+        bst = ctypes.c_void_p()
+        n_iters = ctypes.c_int(0)
+        self._check(self.lib.LGBM_BoosterLoadModelFromString(
+            text.encode(), ctypes.byref(n_iters), ctypes.byref(bst)))
+        return bst
+
+    def predict_raw(self, bst, x):
+        x = np.ascontiguousarray(x, np.float64)
+        nrow = x.shape[0]
+        out_len = ctypes.c_int64(0)
+        out = np.zeros(nrow * 8, np.float64)
+        self._check(self.lib.LGBM_BoosterPredictForMat(
+            bst, x.ctypes.data_as(ctypes.c_void_p), DTYPE_F64,
+            ctypes.c_int32(nrow), ctypes.c_int32(x.shape[1]),
+            ctypes.c_int(1), ctypes.c_int(PREDICT_RAW), ctypes.c_int(-1),
+            b"", ctypes.byref(out_len), out.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_double))))
+        return out[:out_len.value].copy()
+
+    def free_booster(self, bst):
+        self.lib.LGBM_BoosterFree(bst)
+
+    def free_dataset(self, ds):
+        self.lib.LGBM_DatasetFree(ds)
+
+
+MODELS = {
+    "binary": dict(
+        label="bin",
+        params="objective=binary metric=binary_logloss num_leaves=15 "
+               "learning_rate=0.1 min_data_in_leaf=5 num_threads=1 "
+               "verbosity=-1 max_bin=255",
+        iters=20),
+    "regression": dict(
+        label="reg",
+        params="objective=regression metric=l2 num_leaves=31 "
+               "learning_rate=0.05 min_data_in_leaf=20 lambda_l1=0.5 "
+               "lambda_l2=1.0 num_threads=1 verbosity=-1 max_bin=63",
+        iters=15),
+    "multiclass": dict(
+        label="mc",
+        params="objective=multiclass num_class=3 metric=multi_logloss "
+               "num_leaves=7 learning_rate=0.1 min_data_in_leaf=10 "
+               "num_threads=1 verbosity=-1 max_bin=127",
+        iters=10),
+}
+
+
+def gen_models(ref: Ref):
+    x = pd.make_features()
+    y_bin, y_reg, y_mc = pd.make_labels(x)
+    labels = {"bin": y_bin, "reg": y_reg, "mc": y_mc}
+    for name, spec in MODELS.items():
+        ds = ref.dataset(x, labels[spec["label"]], "max_bin=255")
+        bst, evals = ref.train(ds, spec["params"], spec["iters"])
+        text = ref.save_to_string(bst)
+        preds = ref.predict_raw(bst, x[:pd.PRED_ROWS])
+        with open(f"{FIXDIR}/ref_{name}.model.txt", "w") as fh:
+            fh.write(text)
+        np.savetxt(f"{FIXDIR}/ref_{name}.preds.txt", preds, fmt="%.17g")
+        with open(f"{FIXDIR}/ref_{name}.eval.json", "w") as fh:
+            json.dump({"params": spec["params"], "evals": evals}, fh,
+                      indent=1)
+        ref.free_booster(bst)
+        ref.free_dataset(ds)
+        print(f"{name}: {len(text)} chars, {len(preds)} preds, "
+              f"final eval {evals[-1]}")
+
+    # categorical model
+    xc = pd.make_categorical_features()
+    yc = pd.make_categorical_labels(xc)
+    ds = ref.dataset(xc, yc, "max_bin=255 categorical_feature=0,1")
+    params = ("objective=binary metric=binary_logloss num_leaves=15 "
+              "learning_rate=0.1 min_data_in_leaf=5 num_threads=1 "
+              "verbosity=-1 max_bin=255 categorical_feature=0,1 "
+              "min_data_per_group=10 cat_smooth=10 cat_l2=10")
+    bst, evals = ref.train(ds, params, 15)
+    text = ref.save_to_string(bst)
+    preds = ref.predict_raw(bst, xc[:pd.PRED_ROWS])
+    with open(f"{FIXDIR}/ref_categorical.model.txt", "w") as fh:
+        fh.write(text)
+    np.savetxt(f"{FIXDIR}/ref_categorical.preds.txt", preds, fmt="%.17g")
+    with open(f"{FIXDIR}/ref_categorical.eval.json", "w") as fh:
+        json.dump({"params": params, "evals": evals}, fh, indent=1)
+    ref.free_booster(bst)
+    ref.free_dataset(ds)
+    print(f"categorical: {len(text)} chars, final eval {evals[-1]}")
+
+
+def gen_roundtrip(ref: Ref):
+    """Train OUR framework, save v2 text, have the REFERENCE load it and
+    predict; commit both sides."""
+    from lightgbm_tpu.basic import Booster, Dataset
+
+    x = pd.make_features()
+    y_bin, _, _ = pd.make_labels(x)
+    params = {"objective": "binary", "num_leaves": 15,
+              "learning_rate": 0.1, "min_data_in_leaf": 5,
+              "max_bin": 255, "verbosity": -1, "device_growth": "off",
+              "deterministic": True}
+    bst = Booster(params, Dataset(x, label=y_bin, params=params))
+    for _ in range(10):
+        bst.update()
+    text = bst.model_to_string()
+    with open(f"{FIXDIR}/ours_binary.model.txt", "w") as fh:
+        fh.write(text)
+    rbst = ref.load_from_string(text)
+    preds = ref.predict_raw(rbst, x[:pd.PRED_ROWS])
+    np.savetxt(f"{FIXDIR}/ref_preds_on_ours.txt", preds, fmt="%.17g")
+    ref.free_booster(rbst)
+    print(f"roundtrip: ours {len(text)} chars -> ref preds "
+          f"mean {preds.mean():.6f}")
+
+
+def gen_bins():
+    tool = os.path.join(ROOT, ".refbuild", "dump_ref_bins")
+    lines = []
+    for name, max_bin, mdib, values in pd.bin_cases():
+        v = np.asarray(values, np.float64)
+        lines.append(f"{name} {max_bin} {mdib} 1 0 {len(v)}")
+        lines.append(" ".join(f"{x:.17g}" for x in v))
+    out = subprocess.run(
+        [tool], input="\n".join(lines), capture_output=True, text=True,
+        env={**os.environ,
+             "LD_LIBRARY_PATH": os.path.join(ROOT, ".refbuild")},
+        check=True)
+    with open(f"{FIXDIR}/ref_bins.jsonl", "w") as fh:
+        fh.write(out.stdout)
+    print(f"bins: {len(out.stdout.splitlines())} cases")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--lib", default=os.path.join(ROOT, ".refbuild",
+                                                  "lib_lightgbm.so"))
+    args = ap.parse_args()
+    os.makedirs(FIXDIR, exist_ok=True)
+    ref = Ref(args.lib)
+    gen_bins()
+    gen_models(ref)
+    gen_roundtrip(ref)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+// Plain-C shim over the fork's modified C API.
+//
+// The reference fork changed LGBM_BoosterCreate / PredictForMat (and
+// friends) to take std::unordered_map<std::string,std::string> parameters
+// (include/LightGBM/c_api.h:152,342,632 — its own consumer is
+// src/test.cpp), which ctypes cannot call.  This shim rebuilds the map
+// from a "key=value key=value" string and forwards, exporting an
+// unmangled C ABI for scripts/make_parity_fixtures.py.
+//
+// Build: g++ -O2 -std=c++11 -fopenmp -shared -fPIC \
+//   -I /root/reference/include scripts/ref_shim.cpp \
+//   -L .refbuild -l_lightgbm -o .refbuild/ref_shim.so
+#include <LightGBM/c_api.h>
+#include <LightGBM/utils/common.h>
+
+#include <string>
+#include <unordered_map>
+
+static std::unordered_map<std::string, std::string> ParseMap(
+    const char* parameters) {
+  std::unordered_map<std::string, std::string> out;
+  for (const auto& kv :
+       LightGBM::Common::Split(parameters, " \t\n\r")) {
+    auto pos = kv.find('=');
+    if (pos != std::string::npos) {
+      out[kv.substr(0, pos)] = kv.substr(pos + 1);
+    }
+  }
+  return out;
+}
+
+extern "C" {
+
+int Shim_BoosterCreate(const void* train_data, const char* parameters,
+                       void** out) {
+  return LGBM_BoosterCreate(const_cast<void*>(train_data),
+                            ParseMap(parameters), out);
+}
+
+int Shim_BoosterPredictForMat(void* handle, const void* data, int data_type,
+                              int32_t nrow, int32_t ncol, int is_row_major,
+                              int predict_type, int num_iteration,
+                              const char* parameter, int64_t* out_len,
+                              double* out_result) {
+  // PredictForMat kept the const char* parameter in this fork
+  return LGBM_BoosterPredictForMat(handle, data, data_type, nrow, ncol,
+                                   is_row_major, predict_type,
+                                   num_iteration, parameter, out_len,
+                                   out_result);
+}
+
+}  // extern "C"

@@ -1,0 +1,93 @@
+"""Deterministic datasets shared by the parity fixtures and their tests.
+
+The fixture generator (``scripts/make_parity_fixtures.py``) trains the
+REFERENCE implementation (built from ``/root/reference`` into
+``.refbuild/lib_lightgbm.so``) on exactly these arrays and commits the
+resulting model texts / predictions / bin boundaries under
+``tests/fixtures/``.  ``tests/test_parity.py`` regenerates the same
+arrays (NumPy ``Generator`` bit streams are stable across versions) and
+asserts this implementation reproduces the committed outputs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+SEED = 20260730
+N_ROWS = 2000
+PRED_ROWS = 256          # rows predicted in the fixtures
+
+
+def make_features(rows: int = N_ROWS) -> np.ndarray:
+    """(rows, 10) float64 with the distribution shapes the reference's
+    GreedyFindBin has to handle: normal, skewed, low-cardinality,
+    missing-heavy, constant, binary, heavy-tailed, scaled, zero-inflated,
+    uniform."""
+    rng = np.random.default_rng(SEED)
+    cols = [
+        rng.standard_normal(rows),
+        rng.lognormal(0.0, 1.0, rows),
+        rng.integers(0, 5, rows).astype(np.float64),
+        np.where(rng.random(rows) < 0.15, np.nan,
+                 rng.standard_normal(rows)),
+        np.full(rows, 3.14),
+        (rng.random(rows) < 0.3).astype(np.float64),
+        rng.standard_t(3, rows),
+        rng.standard_normal(rows) * 100.0,
+        np.where(rng.random(rows) < 0.7, 0.0, rng.exponential(2.0, rows)),
+        rng.random(rows),
+    ]
+    return np.ascontiguousarray(np.stack(cols, axis=1))
+
+
+def make_labels(x: np.ndarray):
+    """(binary, regression, multiclass3) labels from a fixed concept."""
+    rng = np.random.default_rng(SEED + 1)
+    z = np.nan_to_num(x[:, 0]) + 0.5 * np.log1p(x[:, 1]) \
+        + 0.3 * x[:, 2] - 0.2 * np.nan_to_num(x[:, 3]) \
+        + 0.01 * x[:, 7] + np.abs(x[:, 9] - 0.5)
+    y_bin = (z + 0.3 * rng.standard_normal(len(z)) > np.median(z)) \
+        .astype(np.float64)
+    y_reg = z + 0.1 * rng.standard_normal(len(z))
+    q = np.quantile(z, [1 / 3, 2 / 3])
+    y_mc = np.digitize(z, q).astype(np.float64)
+    return y_bin, y_reg, y_mc
+
+
+def make_categorical_features(rows: int = N_ROWS) -> np.ndarray:
+    """(rows, 4) with two genuine categorical columns (ids 0..29 / 0..7)
+    and two numeric ones, for the categorical-split model fixture."""
+    rng = np.random.default_rng(SEED + 2)
+    return np.ascontiguousarray(np.stack([
+        rng.integers(0, 30, rows).astype(np.float64),
+        rng.integers(0, 8, rows).astype(np.float64),
+        rng.standard_normal(rows),
+        rng.random(rows),
+    ], axis=1))
+
+
+def make_categorical_labels(xc: np.ndarray) -> np.ndarray:
+    rng = np.random.default_rng(SEED + 3)
+    lut = np.asarray([1.0 if (i * 2654435761) % 5 < 2 else -1.0
+                      for i in range(30)])
+    z = lut[xc[:, 0].astype(np.int64)] + 0.4 * (xc[:, 1] >= 4.0) \
+        + 0.5 * xc[:, 2]
+    return (z + 0.3 * rng.standard_normal(len(z)) > 0).astype(np.float64)
+
+
+# FindBin parity cases: (name, max_bin, min_data_in_bin, values-builder)
+def bin_cases():
+    rng = np.random.default_rng(SEED + 4)
+    yield "normal_255", 255, 3, rng.standard_normal(5000)
+    yield "normal_63", 63, 3, rng.standard_normal(5000)
+    yield "lognormal_255", 255, 3, rng.lognormal(0, 2, 5000)
+    yield "small_distinct", 255, 3, rng.integers(0, 9, 4000) \
+        .astype(np.float64)
+    yield "with_nan", 255, 3, np.where(rng.random(3000) < 0.2, np.nan,
+                                       rng.standard_normal(3000))
+    yield "zero_inflated", 255, 3, np.where(
+        rng.random(6000) < 0.8, 0.0, rng.exponential(1.0, 6000))
+    yield "negative_heavy", 127, 3, -np.abs(rng.standard_t(2, 5000))
+    yield "tiny_sample", 16, 1, rng.standard_normal(40)
+    yield "ties_heavy", 31, 5, np.round(rng.standard_normal(5000), 1)
+    yield "single_value", 255, 3, np.full(100, 7.25)

@@ -1,0 +1,132 @@
+"""Reference-parity golden tests (VERDICT r3 item 4).
+
+Fixtures under ``tests/fixtures/`` were produced by driving the REFERENCE
+implementation's own C API (``scripts/make_parity_fixtures.py`` against
+``lib_lightgbm.so`` built from ``/root/reference``):
+
+* ``ref_bins.jsonl``          — ``BinMapper::FindBin`` outputs
+  (``src/io/bin.cpp:74-151`` via ``scripts/dump_ref_bins.cpp``)
+* ``ref_<model>.model.txt``   — v2 model text saved by the reference
+  (``src/boosting/gbdt_model_text.cpp:243-330``)
+* ``ref_<model>.preds.txt``   — the reference's raw-score predictions
+* ``ref_<model>.eval.json``   — the reference's train-metric curve
+* ``ours_binary.model.txt`` / ``ref_preds_on_ours.txt`` — OUR saved
+  model and what the reference predicted after loading it
+
+These pin this framework to reference semantics: loading a verbatim
+reference model must reproduce the reference's predictions; our binning
+must match GreedyFindBin bit-for-bit; our training on identical data
+must track the reference's metric curve.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import parity_data as pd
+from lightgbm_tpu.basic import Booster, Dataset
+from lightgbm_tpu.data.binning import BinMapper
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _fixture(name):
+    path = os.path.join(FIXDIR, name)
+    if not os.path.exists(path):
+        pytest.skip(f"fixture {name} missing")
+    return path
+
+
+# ----------------------------------------------------------------------
+# (b) bin boundaries match GreedyFindBin
+# ----------------------------------------------------------------------
+def test_bin_boundaries_match_reference():
+    with open(_fixture("ref_bins.jsonl")) as fh:
+        golden = {rec["name"]: rec
+                  for rec in (json.loads(l) for l in fh if l.strip())}
+    cases = {name: (max_bin, mdib, values)
+             for name, max_bin, mdib, values in pd.bin_cases()}
+    assert set(golden) == set(cases)
+    for name, (max_bin, mdib, values) in cases.items():
+        ref = golden[name]
+        m = BinMapper()
+        m.find_bin(np.asarray(values, np.float64), len(values), max_bin,
+                   mdib, 0, use_missing=True, zero_as_missing=False)
+        assert m.num_bin == ref["num_bin"], name
+        # reference enum order: None=0, Zero=1, NaN=2 (bin.h:22-26)
+        mt = {"none": 0, "zero": 1, "nan": 2}[m.missing_type]
+        assert mt == ref["missing_type"], name
+        ours = [m.bin_to_value(b) for b in range(m.num_bin)]
+        want = list(ref["upper_bounds"])
+        if ref["missing_type"] == 2:
+            # the fork's NaN-bin upper bound is the enum value NaN=2
+            # implicitly converted to double (bin.cpp:285 pushes
+            # MissingType::NaN -> 2.0); it is never compared against
+            # (NaN routing is special-cased), so exempt that slot
+            ours, want = ours[:-1], want[:-1]
+        np.testing.assert_allclose(
+            ours, want, rtol=1e-12, atol=0.0,
+            err_msg=f"bin upper bounds diverge for case {name}")
+
+
+# ----------------------------------------------------------------------
+# (a) loading verbatim reference model text reproduces its predictions
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("name", ["binary", "regression", "multiclass",
+                                  "categorical"])
+def test_reference_model_predictions(name):
+    model_path = _fixture(f"ref_{name}.model.txt")
+    preds_path = _fixture(f"ref_{name}.preds.txt")
+    x = (pd.make_categorical_features() if name == "categorical"
+         else pd.make_features())[:pd.PRED_ROWS]
+    want = np.loadtxt(preds_path)
+    bst = Booster(model_file=model_path)
+    got = np.asarray(bst.predict(x, raw_score=True), np.float64).reshape(-1)
+    np.testing.assert_allclose(
+        got, want.reshape(-1), rtol=1e-5, atol=1e-6,
+        err_msg=f"predictions diverge from the reference for {name}")
+
+
+# ----------------------------------------------------------------------
+# our saved model, loaded by the reference, predicted the same thing
+# ----------------------------------------------------------------------
+def test_our_model_reference_roundtrip():
+    model_path = _fixture("ours_binary.model.txt")
+    preds_path = _fixture("ref_preds_on_ours.txt")
+    x = pd.make_features()[:pd.PRED_ROWS]
+    want = np.loadtxt(preds_path)
+    bst = Booster(model_file=model_path)
+    got = np.asarray(bst.predict(x, raw_score=True), np.float64).reshape(-1)
+    np.testing.assert_allclose(
+        got, want, rtol=1e-5, atol=1e-6,
+        err_msg="our saved model predicts differently than the reference "
+                "loading the same file")
+
+
+# ----------------------------------------------------------------------
+# (c) training on identical data tracks the reference's metric curve
+# ----------------------------------------------------------------------
+def test_training_curve_tracks_reference():
+    with open(_fixture("ref_binary.eval.json")) as fh:
+        golden = json.load(fh)
+    ref_curve = [e[0] for e in golden["evals"]]
+    x = pd.make_features()
+    y_bin, _, _ = pd.make_labels(x)
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "num_leaves": 15, "learning_rate": 0.1,
+              "min_data_in_leaf": 5, "max_bin": 255, "verbosity": -1,
+              "device_growth": "off"}
+    train = Dataset(x, label=y_bin, params=params)
+    bst = Booster(params, train)
+    ours = []
+    for _ in range(len(ref_curve)):
+        bst.update()
+        ours.append(bst.eval_train()[0][2])
+    # identical bins + identical split rules should give a near-identical
+    # optimization trajectory; bf16 histogram rounding allows small drift
+    np.testing.assert_allclose(
+        ours, ref_curve, rtol=0.02,
+        err_msg="binary_logloss curve diverges from the reference run")
+    assert abs(ours[-1] - ref_curve[-1]) / ref_curve[-1] < 0.02
