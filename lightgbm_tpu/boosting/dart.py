@@ -85,11 +85,7 @@ class DART(GBDT):
                 self.drop_index = []
                 return
         # subtract dropped trees from the training score
-        for i in self.drop_index:
-            for k in range(self.num_model):
-                tree = self.models[i * self.num_model + k]
-                tree.apply_shrinkage(-1.0)
-                self._add_tree_everywhere(tree, k, train=True, valid=False)
+        self._negate_dropped_into_train()
         k_drop = len(self.drop_index)
         if not cfg.xgboost_dart_mode:
             self.shrinkage_rate = cfg.learning_rate / (1.0 + k_drop)
@@ -97,6 +93,16 @@ class DART(GBDT):
             self.shrinkage_rate = (cfg.learning_rate if k_drop == 0
                                    else cfg.learning_rate
                                    / (cfg.learning_rate + k_drop))
+
+    def _negate_dropped_into_train(self):
+        """Flip every dropped tree's sign in place and fold the delta into
+        the training score.  Called once to drop (original -> -1x) and
+        again to undo when training stops before _normalize."""
+        for i in self.drop_index:
+            for k in range(self.num_model):
+                tree = self.models[i * self.num_model + k]
+                tree.apply_shrinkage(-1.0)
+                self._add_tree_everywhere(tree, k, train=True, valid=False)
 
     def _normalize(self):
         # valid scores were caught up in _dropping_trees (device path)
@@ -145,12 +151,7 @@ class DART(GBDT):
             # before Normalize) — a latent defect in a stopped-training
             # edge case, deliberately not reproduced; the device path's
             # retroactive stall trim would hit it on every DART stall.
-            for i in self.drop_index:
-                for k in range(self.num_model):
-                    tree = self.models[i * self.num_model + k]
-                    tree.apply_shrinkage(-1.0)
-                    self._add_tree_everywhere(tree, k, train=True,
-                                              valid=False)
+            self._negate_dropped_into_train()
             self.drop_index = []
             return ret
         self._normalize()

@@ -124,14 +124,26 @@ class DeviceGrower:
         # restores the hi/lo split (g,h each as two bf16 columns whose
         # f32-accumulated sum reconstructs f32-exact values).
         self.hist_cols = 5 if getattr(config, "gpu_use_dp", False) else 3
-        # wave width: total columns (W x hist_cols) should fill but not
-        # exceed one 128-lane MXU tile; per-wave matmul cost is
-        # proportional to the column-tile count, so 126 cols at W=42
-        # costs the same per wave as 75 at W=25 but covers 1.68x more
-        # leaves -> proportionally fewer waves per tree.  (r3 measured
-        # W=40 at 5 cols = 200 columns ~2x slower per wave: two tiles.)
-        self.wave_width = min(126 // self.hist_cols,
-                              max(self.num_leaves - 1, 1))
+        # Wave cost measured on the chip (scripts/ubench_hist.py,
+        # 10.5M rows): ~15.9 ms fixed (the one-hot operand generation
+        # over all N, width-independent) + ~0.203 ms per stat column —
+        # LINEAR in columns, not column-tile-quantized, and 72% of MXU
+        # peak at 2 tiles (hist3_w84: 67.1 ms, 141.7 TF).  Since a wave
+        # can split at most the current frontier, the cheapest plan
+        # width-matches each stage to the frontier (doubling) and ends
+        # with one very wide multi-tile wave for the tail: for L=255,
+        # [4,16,32,64,128] costs ~290 ms/tree of histogram vs ~355 for
+        # the old single-tile cap at W=42.  gpu_use_dp (k=5) scales each
+        # width down by 3/k to hold the column budget.
+        scale = 3.0 / self.hist_cols
+        wmax = max(int(128 * scale), 4)
+        self.wave_width = min(wmax, max(self.num_leaves - 1, 1))
+        self.stage_plan = [
+            (ws, cap) for ws, cap in
+            ((4, 8), (16, 32), (max(int(32 * scale), 4), 64),
+             (max(int(64 * scale), 4), 128))
+            if ws < self.wave_width and cap < self.num_leaves
+        ] + [(self.wave_width, None)]
         # Pallas wave-histogram kernel for the full-width stage (VMEM
         # one-hot tiles, see ops/hist_pallas.py).  auto = on for real
         # TPU; einsum keeps the XLA formulation; interpret runs the
@@ -166,7 +178,9 @@ class DeviceGrower:
         g, nb = self.num_groups, self.nb
         w = pending.shape[0]
         k = self.hist_cols
-        if self.use_pallas and w == self.wave_width:
+        if self.use_pallas and w == self.wave_width and w * k <= 128:
+            # the VMEM kernel packs all stat columns into one 128-lane
+            # tile; wider (multi-tile) waves stay on the einsum
             # full-width stage: MXU cost is tile-bound regardless of W,
             # so the VMEM-resident kernel wins; narrow early stages stay
             # on the einsum (XLA lowers small-N contractions cheaper)
@@ -505,8 +519,7 @@ class DeviceGrower:
                 p_small=jnp.concatenate([st.p_small, ext]),
                 p_large=jnp.concatenate([st.p_large, ext]))
 
-        plan = [(ws, cap) for ws, cap in ((4, 8), (16, 32))
-                if ws < W and cap < L] + [(W, None)]
+        plan = self.stage_plan
         st = init
         for ws, cap in plan:
             st = resize(st, ws)

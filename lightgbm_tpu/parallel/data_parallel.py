@@ -241,7 +241,7 @@ class DataParallelTreeLearner(SerialTreeLearner):
             bins, gh = _gather_rows(binned, grad, hess, win, start, count)
             h = _histogram_scan(bins, gh, num_chunks)
             # the one collective per split: global histogram over ICI
-            return jax.lax.psum(h, net.axis)
+            return net.allreduce(h)
 
         self._hist_fns[m] = _hist
         return _hist

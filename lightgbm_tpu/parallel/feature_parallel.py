@@ -147,18 +147,18 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
                 # global feature id (the serial argmax order)
                 gain = packed[F_GAIN]
                 fid = packed[F_FEATURE].astype(jnp.int32)
-                gmax = jax.lax.pmax(gain, net.axis)
+                gmax = net.allreduce_max(gain)
                 is_max = gain == gmax
                 tid = jnp.where(is_max, fid, jnp.iinfo(jnp.int32).max)
-                tmin = jax.lax.pmin(tid, net.axis)
+                tmin = net.allreduce_min(tid)
                 owner = is_max & (fid == tmin)
                 # select via where, NOT multiply: non-owner shards may carry
                 # inf outputs (0/0 leaf math on masked features) and
                 # inf * 0 = NaN would poison the psum
-                packed_g = jax.lax.psum(
-                    jnp.where(owner, packed, 0.0), net.axis)
-                cat_g = jax.lax.psum(
-                    jnp.where(owner, cat.astype(jnp.float32), 0.0), net.axis)
+                packed_g = net.allreduce(
+                    jnp.where(owner, packed, 0.0))
+                cat_g = net.allreduce(
+                    jnp.where(owner, cat.astype(jnp.float32), 0.0))
                 return packed_g, cat_g > 0.5
 
             self._fb_fn = _fb

@@ -71,6 +71,24 @@ class Network:
             raise LightGBMError("Network expects a one-axis mesh; wrap "
                                 "multi-axis meshes in a flat view")
         self.axis = self.mesh.axis_names[0]
+        # trace-time comm accounting: every verb call below corresponds to
+        # ONE collective op in the compiled program, so logging the
+        # payload bytes at trace time records the per-execution comm
+        # volume of each program (the analog of the reference's
+        # "Network::Allreduce" buffer sizes) — used by tests and the
+        # multichip dryrun to substantiate the O(total_bins) vs
+        # O(2k*256) per-split claims.
+        self.comm_log: list = []
+
+    def _log(self, verb: str, x):
+        try:
+            nbytes = int(np.prod(x.shape)) * jnp.dtype(x.dtype).itemsize
+        except Exception:   # noqa: BLE001 — non-array payloads
+            nbytes = 0
+        self.comm_log.append((verb, nbytes))
+
+    def reset_comm_log(self):
+        self.comm_log = []
 
     @property
     def num_machines(self) -> int:
@@ -79,20 +97,25 @@ class Network:
     # -- in-shard_map verbs (Network::Allreduce etc.) -------------------
     def allreduce(self, x):
         """Sum-allreduce (HistogramBinEntry::SumReducer analog)."""
+        self._log("allreduce", x)
         return jax.lax.psum(x, self.axis)
 
     def reduce_scatter(self, x):
         """Sum + scatter along leading axis (Network::ReduceScatter)."""
+        self._log("reduce_scatter", x)
         return jax.lax.psum_scatter(x, self.axis, tiled=True)
 
     def all_gather(self, x):
         """Concatenate along a fresh leading axis (Network::Allgather)."""
+        self._log("all_gather", x)
         return jax.lax.all_gather(x, self.axis)
 
     def allreduce_max(self, x):
+        self._log("allreduce_max", x)
         return jax.lax.pmax(x, self.axis)
 
     def allreduce_min(self, x):
+        self._log("allreduce_min", x)
         return jax.lax.pmin(x, self.axis)
 
     def rank(self):
@@ -102,13 +125,19 @@ class Network:
         """Pick the payload of the rank whose ``key`` is globally maximal,
         ties broken by the smaller ``tie_id`` — the SplitInfo max-reduce
         (``parallel_tree_learner.h:183-207``) as pmax/pmin + masked psum."""
+        self._log("argmax_allreduce:key", key)
+        self._log("argmax_allreduce:tie", tie_id)
         kmax = jax.lax.pmax(key, self.axis)
         is_max = key == kmax
         tid = jnp.where(is_max, tie_id, jnp.iinfo(jnp.int32).max)
         tmin = jax.lax.pmin(tid, self.axis)
         owner = is_max & (tie_id == tmin)
-        sel = lambda v: jax.lax.psum(
-            jnp.where(owner, v.astype(jnp.float32), 0.0), self.axis)
+
+        def sel(v):
+            self._log("argmax_allreduce:payload", v)
+            return jax.lax.psum(
+                jnp.where(owner, v.astype(jnp.float32), 0.0), self.axis)
+
         return jax.tree_util.tree_map(sel, payload), owner
 
     # -- sharding constructors ------------------------------------------

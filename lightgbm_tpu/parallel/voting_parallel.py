@@ -102,7 +102,7 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
             bins, gh = _gather_rows(binned, grad, hess, win, start, count)
             h = _histogram_scan(bins, gh, num_chunks)      # local (G,256,3)
             loc_tot = h[0].sum(axis=0)                     # local (3,)
-            glob_tot = jax.lax.psum(loc_tot, net.axis)
+            glob_tot = net.allreduce(loc_tot)
             return h, loc_tot[None], glob_tot
 
         self._local_hist_fns[m] = _hist
@@ -197,7 +197,7 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
                 out_specs=self._rep_spec, check_vma=False)
             def _gather(h_sh, me):
                 fh_raw = gather_feature_histograms(h_sh.reshape(-1, 3), me)
-                return jax.lax.psum(fh_raw, net.axis)
+                return net.allreduce(fh_raw)
 
             self._gather_fn = _gather
         fh_raw = self._gather_fn(hist_sh, meta_e)

@@ -14,6 +14,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..data.binning import K_ZERO_THRESHOLD
+
 K_CATEGORICAL_MASK = 1
 K_DEFAULT_LEFT_MASK = 2
 
@@ -186,7 +188,7 @@ class Tree:
         missing = (dt.astype(np.int32) >> 2) & 3
         nan_mask = np.isnan(fval)
         v = np.where(nan_mask & (missing != 2), 0.0, fval)
-        is_miss = ((missing == 1) & (np.abs(v) <= 1e-35)) | \
+        is_miss = ((missing == 1) & (np.abs(v) <= K_ZERO_THRESHOLD)) | \
                   ((missing == 2) & nan_mask)
         left = np.where(is_miss, default_left, v <= self.threshold[node])
         if self.num_cat > 0 and is_cat.any():
@@ -500,7 +502,8 @@ def _tree_shap(tree: Tree, row, contribs, node=0, unique_depth=0,
         lo, hi = tree.cat_boundaries[cat_idx], tree.cat_boundaries[cat_idx + 1]
         left = iv >= 0 and find_in_bitset(tree.cat_threshold[lo:hi], iv)
     else:
-        if (missing == 1 and abs(v) <= 1e-35) or (missing == 2 and np.isnan(v)):
+        if (missing == 1 and abs(v) <= K_ZERO_THRESHOLD) \
+                or (missing == 2 and np.isnan(v)):
             left = default_left
         else:
             left = v <= tree.threshold[node]

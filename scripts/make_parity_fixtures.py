@@ -154,7 +154,11 @@ def gen_models(ref: Ref):
     y_bin, y_reg, y_mc = pd.make_labels(x)
     labels = {"bin": y_bin, "reg": y_reg, "mc": y_mc}
     for name, spec in MODELS.items():
-        ds = ref.dataset(x, labels[spec["label"]], "max_bin=255")
+        # bin with the model's own max_bin (the dataset owns binning; a
+        # mismatched dataset-vs-train max_bin would silently train on
+        # different bins than the recorded params claim)
+        mb = spec["params"].split("max_bin=")[1].split()[0]
+        ds = ref.dataset(x, labels[spec["label"]], f"max_bin={mb}")
         bst, evals = ref.train(ds, spec["params"], spec["iters"])
         text = ref.save_to_string(bst)
         preds = ref.predict_raw(bst, x[:pd.PRED_ROWS])

@@ -1,11 +1,13 @@
 // Plain-C shim over the fork's modified C API.
 //
-// The reference fork changed LGBM_BoosterCreate / PredictForMat (and
-// friends) to take std::unordered_map<std::string,std::string> parameters
-// (include/LightGBM/c_api.h:152,342,632 — its own consumer is
+// The reference fork changed LGBM_BoosterCreate (and the CSR/CSC dataset
+// constructors) to take std::unordered_map<std::string,std::string>
+// parameters (include/LightGBM/c_api.h:152,342 — its own consumer is
 // src/test.cpp), which ctypes cannot call.  This shim rebuilds the map
 // from a "key=value key=value" string and forwards, exporting an
-// unmangled C ABI for scripts/make_parity_fixtures.py.
+// unmangled C ABI for scripts/make_parity_fixtures.py.  PredictForMat
+// kept the plain const char* parameter in this fork, so the generator
+// calls it directly via ctypes.
 //
 // Build: g++ -O2 -std=c++11 -fopenmp -shared -fPIC \
 //   -I /root/reference/include scripts/ref_shim.cpp \
@@ -35,18 +37,6 @@ int Shim_BoosterCreate(const void* train_data, const char* parameters,
                        void** out) {
   return LGBM_BoosterCreate(const_cast<void*>(train_data),
                             ParseMap(parameters), out);
-}
-
-int Shim_BoosterPredictForMat(void* handle, const void* data, int data_type,
-                              int32_t nrow, int32_t ncol, int is_row_major,
-                              int predict_type, int num_iteration,
-                              const char* parameter, int64_t* out_len,
-                              double* out_result) {
-  // PredictForMat kept the const char* parameter in this fork
-  return LGBM_BoosterPredictForMat(handle, data, data_type, nrow, ncol,
-                                   is_row_major, predict_type,
-                                   num_iteration, parameter, out_len,
-                                   out_result);
 }
 
 }  // extern "C"

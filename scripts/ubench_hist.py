@@ -127,7 +127,10 @@ def main():
 
     for name, ghk, w in [("hist5_w25", gh5, 25),
                          ("hist3_w25", gh3, 25),
-                         ("hist3_w42", gh3, 42)]:
+                         ("hist3_w42", gh3, 42),
+                         ("hist3_w4", gh3, 4),
+                         ("hist3_w84", gh3, 84),
+                         ("hist3_w126", gh3, 126)]:
         if not on(name):
             continue
         pend0 = jnp.arange(w, dtype=jnp.int32)

@@ -206,3 +206,70 @@ def test_goss_under_row_sharded_learners(binary_data, kind):
     auc_s = dict((n, v) for _, n, v, _ in serial.eval_valid())["auc"]
     auc_p = dict((n, v) for _, n, v, _ in par.eval_valid())["auc"]
     assert auc_p > auc_s - 0.01, (auc_s, auc_p)
+
+
+def test_comm_volume_data_vs_voting(binary_data):
+    """Substantiate the per-split comm claims with measured payloads
+    (VERDICT r3 item 8): data-parallel's dominant collective is the full
+    O(total_bins) histogram psum (data_parallel_tree_learner.cpp:159-160
+    analog), voting's is the elected-features-only gather
+    (voting_parallel_tree_learner.cpp:365-366) — O(2k*256) and several
+    times smaller.  Network logs payload bytes at trace time; each logged
+    entry is one collective op in the compiled split program."""
+    from lightgbm_tpu.parallel.network import make_mesh
+
+    x, y, _, _ = binary_data
+
+    def largest_hist_payload(kind, extra):
+        cfg = Config(dict({"objective": "binary", "num_leaves": 15,
+                           "tree_learner": kind, "num_machines": 8,
+                           "verbosity": -1}, **extra))
+        ds = BinnedDataset.construct_from_matrix(x, cfg, ())
+        ds.metadata.set_label(y)
+        learner = create_tree_learner(cfg, ds, mesh=make_mesh(8))
+        net = learner.net
+        net.reset_comm_log()
+        g = jnp.asarray((0.5 - y).astype(np.float32))
+        h = jnp.full(len(y), 0.25, jnp.float32)
+        tree = learner.train(g, h)
+        assert tree.num_leaves > 1
+        allred = [b for v, b in net.comm_log if v == "allreduce"]
+        return max(allred), ds
+
+    top_k = 2
+    data_bytes, ds = largest_hist_payload("data", {})
+    voting_bytes, _ = largest_hist_payload("voting", {"top_k": top_k})
+
+    # data-parallel: one full (G, 256, 3) f32 histogram allreduce
+    total_bins_bytes = ds.num_groups * 256 * 3 * 4
+    assert data_bytes == total_bins_bytes, (data_bytes, total_bins_bytes)
+    # voting: 2k elected features' histograms (256 bins, 3 stats, f32)
+    elect_bytes = 2 * top_k * 256 * 3 * 4
+    assert voting_bytes <= elect_bytes + 3 * 4, (voting_bytes, elect_bytes)
+    assert data_bytes > 5 * voting_bytes, (data_bytes, voting_bytes)
+
+
+def test_distributed_long_run_with_bagging_and_valid(binary_data):
+    """20+ iteration distributed train (bagging + valid set) reaches the
+    serial run's quality; GOSS voting likewise (VERDICT r3 item 8).
+    Exact tree equality cannot hold under bagging (the bag is drawn over
+    per-shard permutation buffers), so quality parity is the contract."""
+    x, y, xt, yt = binary_data
+    base = {"objective": "binary", "metric": "auc", "num_leaves": 31,
+            "learning_rate": 0.1, "bagging_fraction": 0.8,
+            "bagging_freq": 2}
+    serial = _train_boosted(base, x, y, 22, valid=(xt, yt))
+    auc_s = dict((n, v) for _, n, v, _ in serial.eval_valid())["auc"]
+    par = _train_boosted(dict(base, tree_learner="data", num_machines=8),
+                         x, y, 22, valid=(xt, yt))
+    auc_p = dict((n, v) for _, n, v, _ in par.eval_valid())["auc"]
+    assert auc_p > auc_s - 0.01, (auc_s, auc_p)
+
+    goss = _train_boosted({"objective": "binary", "metric": "auc",
+                           "boosting": "goss", "num_leaves": 31,
+                           "learning_rate": 0.1, "top_rate": 0.3,
+                           "other_rate": 0.2, "tree_learner": "voting",
+                           "num_machines": 8, "top_k": 10},
+                          x, y, 22, valid=(xt, yt))
+    auc_g = dict((n, v) for _, n, v, _ in goss.eval_valid())["auc"]
+    assert auc_g > auc_s - 0.02, (auc_s, auc_g)
