@@ -671,12 +671,18 @@ class GBDT:
         total_iter = self.num_iterations()
         end_iter = total_iter if num_iteration <= 0 \
             else min(num_iteration, total_iter)
+        from ..tree.tree import tree_shap_batch
         out = np.zeros((n, self.num_model, nf + 1), np.float64)
-        for it in range(end_iter):
-            for k in range(self.num_model):
-                tree = self.models[it * self.num_model + k]
-                for i in range(n):
-                    tree.predict_contrib_row(data[i], out[i, k])
+        # batched TreeSHAP: the recursion is vectorized over rows
+        # (tree.py tree_shap_batch); chunk rows to bound the (depth x
+        # rows) path-state working set
+        chunk = 4096
+        for lo in range(0, n, chunk):
+            hi = min(lo + chunk, n)
+            for it in range(end_iter):
+                for k in range(self.num_model):
+                    tree = self.models[it * self.num_model + k]
+                    tree_shap_batch(tree, data[lo:hi], out[lo:hi, k])
         if self.num_model == 1:
             return out[:, 0, :]
         return out.reshape(n, -1)

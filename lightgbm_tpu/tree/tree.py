@@ -466,6 +466,172 @@ def _unwound_path_sum(path, unique_depth, path_index):
     return total
 
 
+class _BatchPath:
+    """Path state for TreeSHAP over a BATCH of rows.
+
+    The Lundberg recursion's control flow — DFS order, which feature sits
+    at each path position, where a duplicate feature is found — depends
+    only on the TREE, not the row; only the numeric fractions/pweights
+    are row-dependent.  So the scalar algorithm vectorizes by promoting
+    each path element's (zero_fraction, one_fraction, pweight) to a
+    (rows,) array while feature indices stay scalars.  This replaces the
+    reference's per-row ``TreeSHAP`` (``tree.h:466-485``,
+    ``src/io/tree.cpp``) with one whose cost is amortized over the whole
+    batch — the O(rows x trees) pure-Python loop was unusable beyond toy
+    sizes.
+    """
+
+    __slots__ = ("feature", "zero", "one", "pweight")
+
+    def __init__(self, depth_cap, rows):
+        self.feature = np.full(depth_cap, -1, np.int64)
+        self.zero = np.zeros((depth_cap, rows))
+        self.one = np.zeros((depth_cap, rows))
+        self.pweight = np.zeros((depth_cap, rows))
+
+    def fork(self, k):
+        """Copy of the first ``k`` path positions.  Positions >= k are
+        left uninitialized: _extend_batch always writes a position fully
+        before any read, so stale tails are never observed."""
+        out = _BatchPath.__new__(_BatchPath)
+        out.feature = self.feature.copy()
+        out.zero = np.empty_like(self.zero)
+        out.one = np.empty_like(self.one)
+        out.pweight = np.empty_like(self.pweight)
+        out.zero[:k] = self.zero[:k]
+        out.one[:k] = self.one[:k]
+        out.pweight[:k] = self.pweight[:k]
+        return out
+
+
+def _extend_batch(p: _BatchPath, ud, zero_fraction, one_fraction, feature):
+    p.feature[ud] = feature
+    p.zero[ud] = zero_fraction
+    p.one[ud] = one_fraction
+    p.pweight[ud] = 1.0 if ud == 0 else 0.0
+    for i in range(ud - 1, -1, -1):
+        p.pweight[i + 1] += one_fraction * p.pweight[i] * (i + 1) / (ud + 1)
+        p.pweight[i] = zero_fraction * p.pweight[i] * (ud - i) / (ud + 1)
+
+
+def _unwind_batch(p: _BatchPath, ud, path_index):
+    one = p.one[path_index]
+    zero = p.zero[path_index]
+    nonzero = one != 0
+    safe_one = np.where(nonzero, one, 1.0)
+    safe_zero = np.where(zero != 0, zero, 1.0)
+    next_one = p.pweight[ud].copy()
+    for i in range(ud - 1, -1, -1):
+        tmp = p.pweight[i].copy()   # value copy: the row write below
+        # would otherwise corrupt the old pweight next_one still needs
+        pw_nz = next_one * (ud + 1) / ((i + 1) * safe_one)
+        pw_z = tmp * (ud + 1) / (safe_zero * (ud - i))
+        p.pweight[i] = np.where(nonzero, pw_nz, pw_z)
+        # the zero-one_fraction branch leaves next_one untouched
+        next_one = np.where(nonzero,
+                            tmp - pw_nz * zero * (ud - i) / (ud + 1),
+                            next_one)
+    for i in range(path_index, ud):
+        p.feature[i] = p.feature[i + 1]
+        p.zero[i] = p.zero[i + 1]
+        p.one[i] = p.one[i + 1]
+
+
+def _unwound_sum_batch(p: _BatchPath, ud, path_index):
+    one = p.one[path_index]
+    zero = p.zero[path_index]
+    nonzero = one != 0
+    safe_one = np.where(nonzero, one, 1.0)
+    safe_zero = np.where(zero != 0, zero, 1.0)
+    next_one = p.pweight[ud].copy()
+    total = np.zeros_like(next_one)
+    for i in range(ud - 1, -1, -1):
+        tmp = next_one * (ud + 1) / ((i + 1) * safe_one)
+        total += np.where(nonzero, tmp,
+                          p.pweight[i] * (ud + 1) / (safe_zero * (ud - i)))
+        # the zero-one_fraction branch leaves next_one untouched
+        next_one = np.where(nonzero,
+                            p.pweight[i] - tmp * zero * (ud - i) / (ud + 1),
+                            next_one)
+    return total
+
+
+def _decide_left_batch(tree: Tree, rows: np.ndarray, node: int):
+    """(rows,) bool: whether each row follows the left child at node.
+    Delegates to Tree._decision_matrix so the split-decision semantics
+    (missing modes, zero threshold, categorical bitsets) live in exactly
+    one place."""
+    nodes = np.full(rows.shape[0], node, np.int32)
+    return tree._decision_matrix(nodes, rows[:, tree.split_feature[node]])
+
+
+def tree_shap_batch(tree: Tree, rows: np.ndarray, contribs: np.ndarray):
+    """TreeSHAP for a batch: rows (B, F) float64, contribs (B, F+1)
+    accumulated in place (last column gets the expected value)."""
+    contribs[:, -1] += tree.expected_value()
+    if tree.num_leaves <= 1:
+        return
+    # structural max depth (leaf_depth is not serialized in model text,
+    # so walk the children arrays rather than trusting it)
+    depth = {0: 0}
+    max_d = 0
+    for node in range(tree.num_leaves - 1):
+        d = depth[node] + 1
+        for c in (int(tree.left_child[node]), int(tree.right_child[node])):
+            if c >= 0:
+                depth[c] = d
+        max_d = max(max_d, d)
+    depth_cap = max_d + 2
+    nrows = rows.shape[0]
+
+    def child_count(c):
+        return float(tree.leaf_count[~c] if c < 0
+                     else tree.internal_count[c])
+
+    def recurse(node, ud, parent: _BatchPath, parent_zero, parent_one,
+                parent_feature):
+        path = parent.fork(ud + 1)
+        _extend_batch(path, ud, parent_zero, parent_one, parent_feature)
+
+        if node < 0:
+            leaf_v = float(tree.leaf_value[~node])
+            for i in range(1, ud + 1):
+                w = _unwound_sum_batch(path, ud, i)
+                contribs[:, path.feature[i]] += (
+                    w * (path.one[i] - path.zero[i]) * leaf_v)
+            return
+
+        left_mask = _decide_left_batch(tree, rows, node)
+        node_count = max(float(tree.internal_count[node]), 1.0)
+        lc = int(tree.left_child[node])
+        rc = int(tree.right_child[node])
+        l_zero = child_count(lc) / node_count
+        r_zero = child_count(rc) / node_count
+
+        inc_zero = np.ones(nrows)
+        inc_one = np.ones(nrows)
+        feature = int(tree.split_feature[node])
+        path_index = 0
+        while path_index <= ud:
+            if path.feature[path_index] == feature:
+                break
+            path_index += 1
+        if path_index != ud + 1:
+            inc_zero = path.zero[path_index].copy()
+            inc_one = path.one[path_index].copy()
+            _unwind_batch(path, ud, path_index)
+            ud -= 1
+
+        recurse(lc, ud + 1, path, l_zero * inc_zero,
+                inc_one * left_mask.astype(np.float64), feature)
+        recurse(rc, ud + 1, path, r_zero * inc_zero,
+                inc_one * (~left_mask).astype(np.float64), feature)
+
+    root = _BatchPath(depth_cap, nrows)
+    # the root "extend" carries the sentinel parent (feature -1, one=1)
+    recurse(0, 0, root, 1.0, np.ones(nrows), -1)
+
+
 def _tree_shap(tree: Tree, row, contribs, node=0, unique_depth=0,
                parent_path=None, parent_zero_fraction=1.0,
                parent_one_fraction=1.0, parent_feature_index=-1):

@@ -248,3 +248,40 @@ def test_parameters_doc_not_stale():
     assert committed == render(), (
         "docs/Parameters.md is stale; regenerate with "
         "`python -m lightgbm_tpu.utils.gen_docs docs/Parameters.md`")
+
+
+def test_pred_contrib_batch_matches_scalar_oracle():
+    """The vectorized TreeSHAP (tree_shap_batch) must agree with the
+    per-row recursive oracle bit-for-bit, and contributions must sum to
+    the prediction up to the f32-stored expected_value rounding."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.tree.tree import tree_shap_batch
+
+    rng = np.random.default_rng(0)
+    n, f = 400, 8
+    x = rng.standard_normal((n, f))
+    x[rng.random((n, f)) < 0.1] = np.nan
+    y = (np.nan_to_num(x[:, 0]) * 2 + np.abs(np.nan_to_num(x[:, 1]))
+         + 0.1 * rng.standard_normal(n))
+    bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                     "verbosity": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(x, label=y), num_boost_round=8)
+    g = bst._gbdt
+    g._flush_pending()
+    rows = np.ascontiguousarray(x[:48], np.float64)
+    nf = g.max_feature_idx + 1
+    want = np.zeros((48, nf + 1))
+    for it in range(g.num_iterations()):
+        tree = g.models[it]
+        for i in range(48):
+            tree.predict_contrib_row(rows[i], want[i])
+    got = np.zeros((48, nf + 1))
+    for it in range(g.num_iterations()):
+        tree_shap_batch(g.models[it], rows, got)
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-12)
+
+    contrib = np.asarray(bst.predict(rows, pred_contrib=True))
+    np.testing.assert_allclose(contrib, want, rtol=1e-9, atol=1e-12)
+    host_pred = sum(t.predict(rows) for t in g.models)
+    np.testing.assert_allclose(contrib.sum(1), host_pred, atol=2e-3)
