@@ -129,21 +129,40 @@ def run_train(cfg: Config):
 
 
 def run_predict(cfg: Config):
+    """Streaming file prediction: parse chunks behind a double-buffered
+    reader, predict each on device, append to the output file — the
+    TPU build's analog of the reference's parallel line pipeline
+    (``predictor.hpp:170-259``); peak memory is one chunk, not the file."""
     model_path = cfg.input_model or "LightGBM_model.txt"
     booster = GBDT.load_model_from_file(model_path, cfg)
-    arr, _, _ = load_text_file(cfg.data, cfg)
-    pred = booster.predict(
-        arr,
-        num_iteration=int(getattr(cfg, "num_iteration_predict", -1) or -1),
-        raw_score=bool(cfg.predict_raw_score),
-        pred_leaf=bool(cfg.predict_leaf_index),
-        pred_contrib=bool(cfg.predict_contrib))
     out = cfg.output_result or "LightGBM_predict_result.txt"
-    pred2 = np.atleast_2d(np.asarray(pred))
-    if pred2.shape[0] == 1 and np.asarray(pred).ndim == 1:
-        pred2 = pred2.T
-    np.savetxt(out, pred2, delimiter="\t", fmt="%g")
-    log_info(f"Finished prediction, saved to {out}")
+    num_it = int(getattr(cfg, "num_iteration_predict", -1) or -1)
+    kw = dict(num_iteration=num_it,
+              raw_score=bool(cfg.predict_raw_score),
+              pred_leaf=bool(cfg.predict_leaf_index),
+              pred_contrib=bool(cfg.predict_contrib))
+
+    from .data.stream_loader import _Format, _chunk_reader
+    if not os.path.exists(cfg.data):
+        raise LightGBMError(f"could not open data file {cfg.data}")
+    fmt = _Format(cfg.data, cfg)
+    nf = booster.max_feature_idx + 1
+    n_rows = 0
+    with open(out, "w") as fh:
+        for lines in _chunk_reader(cfg.data, fmt.header):
+            x, _ = fmt.parse_chunk(lines, nf)
+            if x.shape[0] == 0:
+                continue
+            if x.shape[1] < nf:
+                x = np.pad(x, ((0, 0), (0, nf - x.shape[1])),
+                           constant_values=np.nan)
+            pred = np.asarray(booster.predict(x[:, :nf], **kw))
+            pred2 = np.atleast_2d(pred)
+            if pred2.shape[0] == 1 and pred.ndim == 1:
+                pred2 = pred2.T
+            np.savetxt(fh, pred2, delimiter="\t", fmt="%g")
+            n_rows += pred2.shape[0]
+    log_info(f"Finished prediction of {n_rows} rows, saved to {out}")
 
 
 def run_convert_model(cfg: Config):

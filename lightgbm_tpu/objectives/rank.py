@@ -76,7 +76,9 @@ class LambdarankNDCG(ObjectiveFunction):
             while p < lengths[q]:
                 p <<= 1
             self._buckets.setdefault(p, {"q": []})["q"].append(q)
-        for p, b in self._buckets.items():
+        flat_rows = []
+        for p in sorted(self._buckets):
+            b = self._buckets[p]
             qs = b["q"]
             rows = np.full((len(qs), p), num_data, np.int32)   # pad -> dummy
             labs = np.zeros((len(qs), p), np.int32)
@@ -88,11 +90,32 @@ class LambdarankNDCG(ObjectiveFunction):
             b["labels"] = jnp.asarray(labs)
             b["valid"] = jnp.asarray(rows != num_data)
             b["inv_mdcg"] = jnp.asarray(inv_mdcg[qs], jnp.float32)
-            b["batch"] = max(1, _PAIR_BUDGET // (p * p))
+            # clamp to the bucket's own query count: padding to a FULL
+            # batch (the old `(-q) % batch`) made a 5-query bucket
+            # compute 262144 padded queries of garbage — measured 260 ms
+            # for 5 real queries
+            b["batch"] = max(1, min(_PAIR_BUDGET // (p * p), len(qs)))
+            flat_rows.append(rows.reshape(-1))
         self._gain_table = jnp.asarray(self.label_gain, jnp.float32)
+        # inverse permutation: position of each data row in the
+        # concatenated bucket layout, so gradients assemble with ONE
+        # gather instead of per-bucket scatter-adds (measured ~200 ms
+        # per scatter pass at 723k rows)
+        concat = np.concatenate(flat_rows)
+        pos = np.zeros(num_data + 1, np.int64)
+        pos[concat] = np.arange(len(concat))
+        self._inv_perm = jnp.asarray(pos[:num_data], jnp.int32)
+        # static jit arguments, fixed at init (rebuilt tuples would still
+        # hit the jit cache, but there is no reason to re-sort per call)
+        order = sorted(self._buckets)
+        self._grad_arrays = tuple(
+            (self._buckets[p]["rows"], self._buckets[p]["labels"],
+             self._buckets[p]["valid"], self._buckets[p]["inv_mdcg"])
+            for p in order)
+        self._grad_batches = tuple(self._buckets[p]["batch"]
+                                   for p in order)
 
     # ------------------------------------------------------------------
-    @functools.partial(jax.jit, static_argnums=(0, 6))
     def _bucket_grads(self, score_ext, rows, labels, valid, inv_mdcg, batch):
         """score_ext: (N+1,) scores with trailing dummy 0."""
         p = rows.shape[1]
@@ -146,19 +169,27 @@ class LambdarankNDCG(ObjectiveFunction):
             one_batch, (shp(rows), shp(labels), shp(valid), shp(inv_mdcg)))
         return lam.reshape(-1, p)[:q], hes.reshape(-1, p)[:q]
 
+    @functools.partial(jax.jit, static_argnums=(0, 3))
+    def _all_grads(self, score_ext, bucket_arrays, batches, inv_perm):
+        """All buckets in ONE compiled program: ~11 small dispatches (a
+        ~6 ms tunnel floor each) collapse into one."""
+        flats = []
+        for (rows, labels, valid, inv_mdcg), batch in zip(bucket_arrays,
+                                                          batches):
+            lam, hes = self._bucket_grads(score_ext, rows, labels, valid,
+                                          inv_mdcg, batch)
+            flats.append(jnp.stack([lam.reshape(-1), hes.reshape(-1)], 1))
+        # every data row occurs exactly once across buckets: assemble by
+        # gathering the concatenated flat results at the precomputed
+        # positions (one gather vs 2x buckets scatter-adds)
+        return jnp.concatenate(flats)[inv_perm]
+
     def get_gradients(self, scores):
-        n = self.num_data
         score_ext = jnp.concatenate(
             [scores[0].astype(jnp.float32), jnp.zeros(1, jnp.float32)])
-        grad = jnp.zeros(n + 1, jnp.float32)
-        hess = jnp.zeros(n + 1, jnp.float32)
-        for p, b in sorted(self._buckets.items()):
-            lam, hes = self._bucket_grads(score_ext, b["rows"], b["labels"],
-                                          b["valid"], b["inv_mdcg"],
-                                          b["batch"])
-            grad = grad.at[b["rows"]].add(lam)
-            hess = hess.at[b["rows"]].add(hes)
-        grad, hess = grad[:n], hess[:n]
+        gh = self._all_grads(score_ext, self._grad_arrays,
+                             self._grad_batches, self._inv_perm)
+        grad, hess = gh[:, 0], gh[:, 1]
         if self.weights_d is not None:
             grad = grad * self.weights_d
             hess = hess * self.weights_d

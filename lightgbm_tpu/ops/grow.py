@@ -410,18 +410,23 @@ class DeviceGrower:
             # (replaces r3's W-times-unrolled dynamic-slice loop, which
             # re-read leaf_id and re-wrote the update vector per split).
             # Masks are disjoint (a row belongs to at most one selected
-            # leaf), so the masked deltas sum without collisions.
-            cols = jnp.take(binned_t, grp, axis=0).astype(jnp.int32)  # (W,N)
-            shift = jnp.where(db == 0, 1, 0)[:, None]
-            in_range = (cols >= off[:, None]) & (cols
-                                                 < (off + wid)[:, None])
-            bin_ = jnp.where(in_range, cols - off[:, None] + shift,
-                             db[:, None])
-            is_default = bin_ == db[:, None]
-            is_na = (miss[:, None] == 2) & (bin_ == (nbin - 1)[:, None])
+            # leaf), so the masked deltas sum without collisions.  All
+            # values are group-local bins (< nb <= 256), so the whole
+            # (W, N) chain runs in int16 — at W=128 the materialized
+            # intermediates drop from ~5.4 GB to ~2.7 GB of HBM traffic.
+            i16 = lambda a: a.astype(jnp.int16)
+            cols = i16(jnp.take(binned_t, grp, axis=0))           # (W,N)
+            off16, wid16 = i16(off)[:, None], i16(wid)[:, None]
+            db16, nbin16 = i16(db)[:, None], i16(nbin)[:, None]
+            thr16 = i16(thr)[:, None]
+            shift = jnp.where(db16 == 0, jnp.int16(1), jnp.int16(0))
+            in_range = (cols >= off16) & (cols < off16 + wid16)
+            bin_ = jnp.where(in_range, cols - off16 + shift, db16)
+            is_default = bin_ == db16
+            is_na = (miss[:, None] == 2) & (bin_ == nbin16 - 1)
             goes_left = jnp.where(is_default, def_left[:, None],
                                   jnp.where(is_na, dl[:, None],
-                                            bin_ <= thr[:, None]))
+                                            bin_ <= thr16))
             if has_cat:
                 # categorical routing: left iff the decoded bin is in the
                 # winning category set (partition.py:49 semantics); the
@@ -433,9 +438,10 @@ class DeviceGrower:
                     cm.reshape(Ws, 8, 32).astype(jnp.int32)
                     << jnp.arange(32, dtype=jnp.int32)[None, None, :],
                     axis=-1)                                # (W, 8)
-                widx = bin_ >> 5
-                bit = bin_ & 31
-                wv = jnp.zeros_like(bin_)
+                binc = bin_.astype(jnp.int32)   # 32-bit word arithmetic
+                widx = binc >> 5
+                bit = binc & 31
+                wv = jnp.zeros_like(binc)
                 for j in range(8):
                     wv = wv + jnp.where(widx == j, cmw[:, j:j + 1], 0)
                 left_cat = ((wv >> bit) & 1) == 1

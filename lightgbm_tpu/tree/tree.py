@@ -565,14 +565,13 @@ def _decide_left_batch(tree: Tree, rows: np.ndarray, node: int):
     return tree._decision_matrix(nodes, rows[:, tree.split_feature[node]])
 
 
-def tree_shap_batch(tree: Tree, rows: np.ndarray, contribs: np.ndarray):
-    """TreeSHAP for a batch: rows (B, F) float64, contribs (B, F+1)
-    accumulated in place (last column gets the expected value)."""
-    contribs[:, -1] += tree.expected_value()
-    if tree.num_leaves <= 1:
-        return
-    # structural max depth (leaf_depth is not serialized in model text,
-    # so walk the children arrays rather than trusting it)
+def _structural_depth(tree: Tree) -> int:
+    """Max depth from the children arrays (leaf_depth is not serialized
+    in model text, so it cannot be trusted for loaded trees); cached on
+    the tree since SHAP calls this once per row-chunk."""
+    cached = getattr(tree, "_shap_depth", None)
+    if cached is not None:
+        return cached
     depth = {0: 0}
     max_d = 0
     for node in range(tree.num_leaves - 1):
@@ -581,7 +580,17 @@ def tree_shap_batch(tree: Tree, rows: np.ndarray, contribs: np.ndarray):
             if c >= 0:
                 depth[c] = d
         max_d = max(max_d, d)
-    depth_cap = max_d + 2
+    tree._shap_depth = max_d
+    return max_d
+
+
+def tree_shap_batch(tree: Tree, rows: np.ndarray, contribs: np.ndarray):
+    """TreeSHAP for a batch: rows (B, F) float64, contribs (B, F+1)
+    accumulated in place (last column gets the expected value)."""
+    contribs[:, -1] += tree.expected_value()
+    if tree.num_leaves <= 1:
+        return
+    depth_cap = _structural_depth(tree) + 2
     nrows = rows.shape[0]
 
     def child_count(c):
