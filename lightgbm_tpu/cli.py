@@ -33,7 +33,8 @@ def parse_cli_args(argv: List[str]) -> Dict[str, str]:
         k, v = arg.split("=", 1)
         params[k.strip()] = v.strip()
     if "config" in params and params["config"]:
-        with open(params["config"]) as fh:
+        from .utils.file_io import open_text
+        with open_text(params["config"]) as fh:
             file_params = parse_config_str(fh.read())
         # CLI args take precedence over config-file values
         file_params.update(params)
@@ -142,15 +143,11 @@ def run_predict(cfg: Config):
               pred_leaf=bool(cfg.predict_leaf_index),
               pred_contrib=bool(cfg.predict_contrib))
 
-    from .data.stream_loader import _Format, _chunk_reader
-    if not os.path.exists(cfg.data):
-        raise LightGBMError(f"could not open data file {cfg.data}")
-    fmt = _Format(cfg.data, cfg)
+    from .data.stream_loader import iter_parsed_chunks
     nf = booster.max_feature_idx + 1
     n_rows = 0
     with open(out, "w") as fh:
-        for lines in _chunk_reader(cfg.data, fmt.header):
-            x, _ = fmt.parse_chunk(lines, nf)
+        for x, _ in iter_parsed_chunks(cfg.data, cfg, nf):
             if x.shape[0] == 0:
                 continue
             if x.shape[1] < nf:

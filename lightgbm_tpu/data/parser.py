@@ -97,9 +97,8 @@ def load_text_file(path: str, config) -> Tuple[np.ndarray,
                                                Optional[np.ndarray],
                                                Optional[List[str]]]:
     """Load a training text file -> (features, label, feature_names)."""
-    if not os.path.exists(path):
-        raise LightGBMError(f"could not open data file {path}")
-    with open(path) as fh:
+    from ..utils.file_io import open_text
+    with open_text(path) as fh:
         lines = fh.readlines()
     lines = [l for l in lines if l.strip()]
     header = bool(getattr(config, "header", False))
@@ -126,18 +125,24 @@ def load_text_file(path: str, config) -> Tuple[np.ndarray,
 def load_query_file(path: str) -> Optional[np.ndarray]:
     """Side file ``<data>.query`` with per-query counts
     (reference Metadata query loading)."""
-    if not os.path.exists(path):
+    from ..utils.file_io import exists, open_text
+    if not exists(path):
         return None
-    return np.loadtxt(path).astype(np.int64).reshape(-1)
+    with open_text(path) as fh:
+        return np.loadtxt(fh).astype(np.int64).reshape(-1)
 
 
 def load_weight_file(path: str) -> Optional[np.ndarray]:
-    if not os.path.exists(path):
+    from ..utils.file_io import exists, open_text
+    if not exists(path):
         return None
-    return np.loadtxt(path).astype(np.float32).reshape(-1)
+    with open_text(path) as fh:
+        return np.loadtxt(fh).astype(np.float32).reshape(-1)
 
 
 def load_init_score_file(path: str) -> Optional[np.ndarray]:
-    if not os.path.exists(path):
+    from ..utils.file_io import exists, open_text
+    if not exists(path):
         return None
-    return np.loadtxt(path).astype(np.float64)
+    with open_text(path) as fh:
+        return np.loadtxt(fh).astype(np.float64)

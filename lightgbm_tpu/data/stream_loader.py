@@ -23,6 +23,7 @@ from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from ..utils.file_io import open_text
 from ..utils.log import LightGBMError, log_info
 from .parser import _atof, _sniff
 
@@ -37,7 +38,7 @@ def _chunk_reader(path: str, skip_header: bool) -> Iterator[List[str]]:
 
     def reader():
         try:
-            with open(path) as fh:
+            with open_text(path) as fh:
                 if skip_header:
                     fh.readline()
                 while True:
@@ -67,7 +68,7 @@ class _Format:
 
     def __init__(self, path: str, config):
         self.header = bool(getattr(config, "header", False))
-        with open(path) as fh:
+        with open_text(path) as fh:
             if self.header:
                 self.header_line = fh.readline()
             sample = [fh.readline() for _ in range(50)]
@@ -150,6 +151,15 @@ class _Format:
                 c = t.split(":", 1)[0]
                 mx = max(mx, int(c) + 1)
         return mx
+
+
+def iter_parsed_chunks(path: str, config, num_features: int):
+    """Public chunked-parse entry point: yields ``(x, label)`` float64
+    chunks behind the double-buffered reader.  Used by the CLI's
+    streaming prediction (``predictor.hpp:170-259`` analog)."""
+    fmt = _Format(path, config)
+    for lines in _chunk_reader(path, fmt.header):
+        yield fmt.parse_chunk(lines, num_features)
 
 
 def load_text_two_round(path: str, config, categorical=(),

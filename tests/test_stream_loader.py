@@ -121,3 +121,38 @@ def test_cli_two_round(tmp_path):
         l for l in t.splitlines()
         if not l.startswith(("[two_round", "[output_model")))
     assert strip(t1) == strip(t2)
+
+
+def test_virtual_file_io(tmp_path):
+    """file_io scheme dispatch: gzip transparency, clear errors for
+    unregistered schemes, and pluggable handlers (the VirtualFileReader
+    analog, reference src/io/file_io.cpp:13,54)."""
+    import gzip
+    import io
+    import pytest
+    from lightgbm_tpu.utils.file_io import open_text, register_scheme, exists
+    from lightgbm_tpu.utils.log import LightGBMError
+    from lightgbm_tpu.data.parser import load_text_file
+    from lightgbm_tpu.config import Config
+
+    body = "".join(f"{i % 2}\t{i}\t{i * 2}\n" for i in range(100))
+    gz = tmp_path / "data.tsv.gz"
+    with gzip.open(gz, "wt") as fh:
+        fh.write(body)
+    # transparent .gz through the full loader path
+    x, y, _ = load_text_file(str(gz), Config({"verbosity": -1}))
+    assert x.shape == (100, 2) and y.shape == (100,)
+    assert exists(str(gz)) and not exists(str(tmp_path / "nope"))
+
+    with pytest.raises(LightGBMError, match="no filesystem registered"):
+        open_text("hdfs://cluster/path.tsv")
+    with pytest.raises(LightGBMError, match="could not open"):
+        open_text(str(tmp_path / "missing.tsv"))
+
+    from lightgbm_tpu.utils import file_io
+    register_scheme("mem", lambda path, mode: io.StringIO(body))
+    try:
+        with open_text("mem://whatever") as fh:
+            assert len(fh.readlines()) == 100
+    finally:
+        file_io._SCHEMES.pop("mem", None)   # don't leak into other tests
