@@ -144,6 +144,12 @@ def run_predict(cfg: Config):
               pred_contrib=bool(cfg.predict_contrib))
 
     from .data.stream_loader import iter_parsed_chunks
+    from .utils.file_io import exists
+    if not exists(cfg.data):
+        # validate BEFORE truncating the output file: the chunk iterator
+        # is lazy and would only fail after open(out, "w") destroyed any
+        # previous predictions
+        raise LightGBMError(f"could not open data file {cfg.data}")
     nf = booster.max_feature_idx + 1
     n_rows = 0
     with open(out, "w") as fh:

@@ -65,11 +65,37 @@ _CHUNK = int(_os.environ.get("LGBM_TPU_CHUNK", 32768))
 REC_I_FIELDS = 5    # leaf, right, feature, threshold, default_left
 REC_F_FIELDS = 9    # gain, lg, lh, lc, rg, rh, rc, left_out, right_out
 
+# above this many rows a single f32 count cell can exceed 2^24 and lose
+# integer exactness; the wave matmul then carries TWO striped count
+# columns (each stripe < 2^24 rows, summed after accumulation — final
+# count error <= 1 ulp instead of unbounded drift).  Module-level so
+# tests can force the striped path on small data.
+COUNT_SPLIT_ROWS = 1 << 24
+
 
 
 
 def _ceil_to(x: int, m: int) -> int:
     return (x + m - 1) // m * m
+
+
+def _combine_hist_cols(h, k: int):
+    """Collapse the K accumulated stat columns (last axis) to [g, h, cnt].
+    K=3: passthrough.  K=4: striped counts summed.  K=5: hi/lo g,h.
+    K=6: hi/lo g,h + striped counts."""
+    import jax.numpy as _jnp
+    if k == 5:
+        return _jnp.stack([h[..., 0] + h[..., 1], h[..., 2] + h[..., 3],
+                           h[..., 4]], axis=-1)
+    if k == 4:
+        # each stripe accumulated < 2^24 rows exactly; the sum is exact
+        # to <= 1 ulp at up to 2 * COUNT_SPLIT_ROWS rows
+        return _jnp.stack([h[..., 0], h[..., 1], h[..., 2] + h[..., 3]],
+                          axis=-1)
+    if k == 6:
+        return _jnp.stack([h[..., 0] + h[..., 1], h[..., 2] + h[..., 3],
+                           h[..., 4] + h[..., 5]], axis=-1)
+    return h
 
 
 class DeviceGrower:
@@ -123,7 +149,14 @@ class DeviceGrower:
         # histograms, docs/GPU-Performance.rst:128-161).  gpu_use_dp
         # restores the hi/lo split (g,h each as two bf16 columns whose
         # f32-accumulated sum reconstructs f32-exact values).
-        self.hist_cols = 5 if getattr(config, "gpu_use_dp", False) else 3
+        dp = bool(getattr(config, "gpu_use_dp", False))
+        striped = self.num_data >= COUNT_SPLIT_ROWS
+        if dp:
+            # 6 = hi/lo g,h + striped counts: dp must not reintroduce
+            # the single-column count overflow it exists to avoid
+            self.hist_cols = 6 if striped else 5
+        else:
+            self.hist_cols = 4 if striped else 3
         # Wave cost measured on the chip (scripts/ubench_hist.py,
         # 10.5M rows): ~15.9 ms fixed (the one-hot operand generation
         # over all N, width-independent) + ~0.203 ms per stat column —
@@ -190,11 +223,7 @@ class DeviceGrower:
                                    interpret=self.pallas_interpret)
             h = out.reshape(g, nb, k, w).transpose(3, 0, 1, 2) \
                 .reshape(w, self.num_slots, k)
-            if k == 5:
-                return jnp.stack([h[..., 0] + h[..., 1],
-                                  h[..., 2] + h[..., 3],
-                                  h[..., 4]], axis=-1)
-            return h
+            return _combine_hist_cols(h, k)
         ch = _CHUNK
         n_chunks = self.n_pad // ch
         binned_c = binned.reshape(n_chunks, ch, g)
@@ -213,12 +242,7 @@ class DeviceGrower:
         acc0 = jnp.zeros((g, nb, w * k), jnp.float32)
         acc, _ = jax.lax.scan(body, acc0, (binned_c, leaf_c, ghk_c))
         acc = acc.reshape(g, nb, w, k)
-        if k == 5:
-            hist = jnp.stack([acc[..., 0] + acc[..., 1],
-                              acc[..., 2] + acc[..., 3],
-                              acc[..., 4]], axis=-1)             # (G,NB,W,3)
-        else:
-            hist = acc                                           # (G,NB,W,3)
+        hist = _combine_hist_cols(acc, k)                        # (G,NB,W,3)
         return hist.transpose(2, 0, 1, 3).reshape(w, self.num_slots, 3)
 
     # ------------------------------------------------------------------
@@ -264,13 +288,21 @@ class DeviceGrower:
         one = one_f.astype(jnp.bfloat16)
         ghi = grad.astype(jnp.bfloat16)
         hhi = hess.astype(jnp.bfloat16)
-        if self.hist_cols == 5:
+        k = self.hist_cols
+        if k in (5, 6):
             glo = (grad - ghi.astype(jnp.float32)).astype(jnp.bfloat16)
             hlo = (hess - hhi.astype(jnp.float32)).astype(jnp.bfloat16)
-            gh5 = jnp.stack([ghi * one, glo * one, hhi * one, hlo * one,
-                             one], 1)
+            gcols = [ghi * one, glo * one, hhi * one, hlo * one]
         else:
-            gh5 = jnp.stack([ghi * one, hhi * one, one], 1)
+            gcols = [ghi * one, hhi * one]
+        if k in (4, 6):
+            # two striped count columns (< 2^24 rows each) keep counts
+            # integer-exact beyond the single-column f32 limit
+            stripe = (jnp.arange(n) < (n // 2)).astype(jnp.bfloat16)
+            gcols += [one * stripe, one * (1.0 - stripe)]
+        else:
+            gcols += [one]
+        gh5 = jnp.stack(gcols, 1)
 
         leaf_id0 = jnp.where(jnp.arange(n, dtype=jnp.int32) < self.num_data,
                              0, -1)
@@ -601,12 +633,18 @@ class DeviceGrower:
             one = jnp.ones((n,), jnp.bfloat16)
             ghi = g.astype(jnp.bfloat16)
             hhi = h.astype(jnp.bfloat16)
-            if k == 5:
+            if k in (5, 6):
                 glo = (g - ghi.astype(jnp.float32)).astype(jnp.bfloat16)
                 hlo = (h - hhi.astype(jnp.float32)).astype(jnp.bfloat16)
-                ghk = jnp.stack([ghi, glo, hhi, hlo, one], 1)
+                cols = [ghi, glo, hhi, hlo]
             else:
-                ghk = jnp.stack([ghi, hhi, one], 1)
+                cols = [ghi, hhi]
+            if k in (4, 6):
+                stripe = (jnp.arange(n) < (n // 2)).astype(jnp.bfloat16)
+                cols += [stripe, 1.0 - stripe]
+            else:
+                cols += [one]
+            ghk = jnp.stack(cols, 1)
             return self._wave_hist(binned, leaf, ghk, pend)
 
         @jax.jit
@@ -692,7 +730,8 @@ def device_growth_eligible(config, dataset, objective, num_model) -> bool:
         return False
     if getattr(config, "forcedsplits_filename", ""):
         return False
-    # f32 histogram counts stay exact below 2^24 rows
-    if dataset.num_data >= (1 << 24):
+    # single f32 count columns are exact below COUNT_SPLIT_ROWS (2^24);
+    # the striped two-column layout extends that to twice the threshold
+    if dataset.num_data >= 2 * COUNT_SPLIT_ROWS:
         return False
     return True

@@ -445,3 +445,36 @@ def test_device_dart_rf_match_host(reg_data, boosting):
         _assert_trees_close(th, td)
     np.testing.assert_allclose(bd.predict(x[:100]), bh.predict(x[:100]),
                                atol=5e-3)
+
+
+def test_striped_count_columns_match_host(reg_data):
+    """N >= 2^24 rows switches the wave matmul to two striped count
+    columns (hist_cols=4); forced on small data, the device trees must
+    still match the host learner exactly."""
+    import lightgbm_tpu.ops.grow as growmod
+    x, y = reg_data
+    params = {"objective": "regression", "num_leaves": 31,
+              "min_data_in_leaf": 20}
+    old = growmod.COUNT_SPLIT_ROWS
+    try:
+        # force the striped path: threshold <= N < 2x threshold keeps
+        # the configuration device-eligible
+        growmod.COUNT_SPLIT_ROWS = 3000
+        bd = _make(params, x, y, True)
+        assert bd._grower is not None and bd._grower.hist_cols == 4
+        growmod.COUNT_SPLIT_ROWS = old
+        b3 = _make(params, x, y, True)
+        assert b3._grower.hist_cols == 3
+        for _ in range(5):
+            bd.train_one_iter()
+            b3.train_one_iter()
+        bd._flush_pending()
+        b3._flush_pending()
+        # identical g/h columns; counts exact in both layouts at this
+        # size -> identical trees (the stripe only changes the matmul's
+        # column split, summed back before any consumer)
+        pd = np.asarray(bd.predict(x[:256]))
+        p3 = np.asarray(b3.predict(x[:256]))
+        np.testing.assert_allclose(pd, p3, rtol=1e-5, atol=1e-6)
+    finally:
+        growmod.COUNT_SPLIT_ROWS = old
