@@ -32,6 +32,36 @@ from jax.experimental.pallas import tpu as pltpu
 _LANES = 128
 
 
+def _ceil_to(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _build_bmat(leaf_ref, pend_ref, gh_ref, ch, k, w, b):
+    """K-major (CH, B) bf16 stat matrix (column kk*W + slot holds stat kk
+    of wave slot), zero-padded to ``b`` lanes.  Shared by both kernels."""
+    leaf = leaf_ref[:]                                  # (CH, 1) i32
+    pend = pend_ref[0:1, :w]                            # (1, W) i32
+    lm = (leaf == pend).astype(jnp.bfloat16)            # (CH, W)
+    gh = gh_ref[:]                                      # (CH, K) bf16
+    cols = [lm * gh[:, kk:kk + 1] for kk in range(k)]
+    pad = b - k * w
+    if pad:
+        cols.append(jnp.zeros((ch, pad), jnp.bfloat16))
+    return jnp.concatenate(cols, axis=1)                # (CH, B)
+
+
+def _pair_one_hot(bins, iota, g0, g):
+    """(CH, 2*NB) bf16 one-hot tile for group pair (g0, g0+1); the casts
+    happen before the concat — Mosaic cannot bitcast i1 vregs through a
+    concatenate."""
+    if g0 + 1 < g:
+        return jnp.concatenate(
+            [(bins[:, g0:g0 + 1] == iota).astype(jnp.bfloat16),
+             (bins[:, g0 + 1:g0 + 2] == iota).astype(jnp.bfloat16)],
+            axis=1)
+    return (bins[:, g0:g0 + 1] == iota).astype(jnp.bfloat16)
+
+
 def _kernel(binned_ref, leaf_ref, gh_ref, pend_ref, out_ref, *,
             ch: int, g: int, nb: int, k: int, w: int):
     i = pl.program_id(0)
@@ -40,35 +70,95 @@ def _kernel(binned_ref, leaf_ref, gh_ref, pend_ref, out_ref, *,
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    leaf = leaf_ref[:]                                  # (CH, 1) i32
-    pend = pend_ref[0:1, :w]                            # (1, W) i32
-    lm = (leaf == pend).astype(jnp.bfloat16)            # (CH, W)
-    gh = gh_ref[:]                                      # (CH, K) bf16
-    # K-major stat matrix, zero-padded to the 128-lane tile
-    cols = [lm * gh[:, kk:kk + 1] for kk in range(k)]
-    pad = _LANES - k * w
-    if pad:
-        cols.append(jnp.zeros((ch, pad), jnp.bfloat16))
-    bmat = jnp.concatenate(cols, axis=1)                # (CH, 128)
-
+    bmat = _build_bmat(leaf_ref, pend_ref, gh_ref, ch, k, w, _LANES)
     bins = binned_ref[:].astype(jnp.int32)              # (CH, G)
     iota = jax.lax.broadcasted_iota(jnp.int32, (ch, nb), 1)
     for g0 in range(0, g, 2):
-        if g0 + 1 < g:
-            # cast each comparison before the concat — Mosaic cannot
-            # bitcast i1 vregs through a concatenate
-            oh = jnp.concatenate(
-                [(bins[:, g0:g0 + 1] == iota).astype(jnp.bfloat16),
-                 (bins[:, g0 + 1:g0 + 2] == iota).astype(jnp.bfloat16)],
-                axis=1)                                 # (CH, 2*NB)
-        else:
-            oh = (bins[:, g0:g0 + 1] == iota).astype(jnp.bfloat16)
+        oh = _pair_one_hot(bins, iota, g0, g)
         acc = jax.lax.dot_general(
             oh, bmat, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)         # (2*NB, 128)
         r0 = g0 * nb
         r1 = r0 + acc.shape[0]
         out_ref[r0:r1, :] = out_ref[r0:r1, :] + acc
+
+
+def _kernel_v2(binned_ref, leaf_ref, gh_ref, pend_ref, out_ref, oh_ref, *,
+               ch: int, g: int, nb: int, k: int, w: int, b: int):
+    """v2: build the FULL (CH, G*NB) one-hot in a VMEM scratch, then ONE
+    dot per grid step — v1's 14 tiny pair-dots starved the MXU (each
+    (CH,128)x(CH,128) is ~0.2 us of peak work vs its issue overhead).
+
+    MEASURED (10.5M rows, v5e): w42 132 ms / w128 182-211 ms / w4 132 ms
+    — 1.8-7x SLOWER than the XLA einsum (40 / 107 / 18 ms).  The
+    width-independent ~132 ms floor shows the scratch write + dot-from-
+    scratch serialize; Mosaic does not overlap the VPU one-hot build
+    with the MXU.  Kept as a documented negative result: the einsum's
+    fused one-hot is the best known formulation on this hardware."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    bmat = _build_bmat(leaf_ref, pend_ref, gh_ref, ch, k, w, b)
+    bins = binned_ref[:].astype(jnp.int32)              # (CH, G)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (ch, nb), 1)
+    for g0 in range(0, g, 2):
+        tile = _pair_one_hot(bins, iota, g0, g)
+        oh_ref[:, g0 * nb:g0 * nb + tile.shape[1]] = tile
+    acc = jax.lax.dot_general(
+        oh_ref[:], bmat, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)             # (G*NB, B)
+    out_ref[:] = out_ref[:] + acc
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("g", "nb", "k", "w", "ch",
+                                    "interpret"))
+def wave_hist_pallas_v2(binned, leaf_id, ghk, pending, *, g: int, nb: int,
+                        k: int, w: int, ch: int = 4096,
+                        interpret: bool = False):
+    """(n_pad, G) u8, (n_pad,) i32, (n_pad, K) bf16, (W,) i32
+    -> (G*NB, K, W) f32 histogram.  B = k*w rounded up to a lane tile."""
+    n = binned.shape[0]
+    if n % ch:
+        raise ValueError(
+            f"pallas wave-histogram needs rows ({n}) divisible by its "
+            f"chunk ({ch})")
+    b = _ceil_to(k * w, _LANES)
+    grid = (n // ch,)
+    leaf2 = leaf_id.reshape(n, 1)
+    pend2 = pending.reshape(1, w)
+    out = pl.pallas_call(
+        functools.partial(_kernel_v2, ch=ch, g=g, nb=nb, k=k, w=w, b=b),
+        out_shape=jax.ShapeDtypeStruct((g * nb, b), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ch, g), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ch, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ch, ghk.shape[1]), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, w), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((g * nb, b), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((ch, g * nb), jnp.bfloat16)],
+        interpret=interpret,
+        # the one-hot scratch alone is ch*G*NB bf16 (14.7 MB at ch=4096);
+        # the default 16 MB scoped-vmem budget needs raising
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n * g * nb * b,
+            bytes_accessed=n * (g + 4 + 2 * k) + g * nb * b * 4,
+            transcendentals=0,
+        ),
+    )(binned, leaf2, ghk, pend2)
+    return out[:, :k * w].reshape(g * nb, k, w)
 
 
 @functools.partial(jax.jit,

@@ -21,6 +21,9 @@ import time
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                       os.path.expanduser("~/.cache/lgbm_tpu_xla"))
 
+import sys as _sys
+_sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -137,6 +140,29 @@ def main():
         flops = n * g * nb * w * ghk.shape[1] * 2
         run_case(name, functools.partial(hist_body, w),
                  (jnp.float32(0), pend0), arrays=(binned, leaf_id, ghk),
+                 iters=it, flops=flops)
+
+    # ---- Pallas v2 kernel vs the einsum --------------------------------
+    def pallas_v2_body(w, ch, st, i, arrs):
+        from lightgbm_tpu.ops.hist_pallas import wave_hist_pallas_v2
+        binned_a, leaf_a, ghk = arrs
+        acc_sum, pending = st
+        out = wave_hist_pallas_v2(binned_a, leaf_a, ghk, pending,
+                                  g=g, nb=nb, k=ghk.shape[1], w=w, ch=ch)
+        s = jnp.sum(out)
+        shift = (s * 1e-30).astype(jnp.int32) + 1
+        return acc_sum + s, (pending + shift) % 64
+
+    for name, w, ch in [("pallas2_w42_ch4096", 42, 4096),
+                        ("pallas2_w128_ch4096", 128, 4096),
+                        ("pallas2_w128_ch2048", 128, 2048),
+                        ("pallas2_w4_ch4096", 4, 4096)]:
+        if not on(name):
+            continue
+        pend0 = jnp.arange(w, dtype=jnp.int32)
+        flops = n * g * nb * w * 3 * 2
+        run_case(name, functools.partial(pallas_v2_body, w, ch),
+                 (jnp.float32(0), pend0), arrays=(binned, leaf_id, gh3),
                  iters=it, flops=flops)
 
     # ---- row gather + compact (deep-wave path) -------------------------
