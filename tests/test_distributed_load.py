@@ -1,0 +1,100 @@
+"""Distributed find-bin + pre-partitioned loading (VERDICT r3 missing #3;
+reference dataset_loader.cpp:765-923 / :657-704 semantics)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.data.binning import BinMapper
+from lightgbm_tpu.data.dataset import BinnedDataset
+from lightgbm_tpu.data.distributed import (allgather_mappers,
+                                           construct_pre_partitioned,
+                                           find_bin_shard,
+                                           partition_features)
+
+
+def test_partition_matches_reference_arithmetic():
+    # dataset_loader.cpp:846-857: contiguous blocks of ceil(nf/m)
+    for nf, m in [(28, 4), (10, 3), (3, 8), (136, 8), (1, 2)]:
+        start, length = partition_features(nf, m)
+        assert sum(length) == nf
+        assert start[0] == 0
+        for i in range(m - 1):
+            assert start[i + 1] == start[i] + length[i]
+        assert max(length) <= max((nf + m - 1) // m, 1)
+
+
+def test_identical_samples_reproduce_local_mappers():
+    """With every shard holding the SAME sample, the distributed path
+    must reproduce single-host find_bin exactly (mapper serialization
+    round-trips bit-exactly)."""
+    rng = np.random.default_rng(0)
+    x = np.ascontiguousarray(np.stack([
+        rng.standard_normal(3000),
+        rng.lognormal(0, 1, 3000),
+        np.where(rng.random(3000) < 0.2, np.nan, rng.standard_normal(3000)),
+        np.where(rng.random(3000) < 0.7, 0.0, rng.exponential(1, 3000)),
+        rng.integers(0, 6, 3000).astype(np.float64),
+    ], axis=1))
+    cfg = Config({"objective": "regression", "max_bin": 63,
+                  "verbosity": -1})
+    pairs = [find_bin_shard(x, rank, 4, cfg) for rank in range(4)]
+    mappers = allgather_mappers(pairs)
+    assert len(mappers) == x.shape[1]
+    ds = BinnedDataset.construct_from_matrix(x, cfg)
+    for f in range(x.shape[1]):
+        ref = ds.bin_mappers[f]
+        got = mappers[f]
+        if ref is None:
+            continue
+        assert got.num_bin == ref.num_bin, f
+        for b in range(ref.num_bin):
+            assert got.bin_to_value(b) == ref.bin_to_value(b) or (
+                np.isnan(got.bin_to_value(b))
+                and np.isnan(ref.bin_to_value(b))), (f, b)
+
+
+def test_pre_partitioned_trains_to_single_host_quality(binary_data):
+    """Shard rows over 4 'machines', run the full pre-partitioned
+    pipeline, train data-parallel on the 8-device mesh; quality must
+    match single-host construction (bins are an owner-shard
+    approximation, so trees may differ slightly — the contract is
+    metric parity, like the reference's own distributed tests)."""
+    from lightgbm_tpu.boosting import create_boosting
+
+    x, y, xt, yt = binary_data
+    params = {"objective": "binary", "metric": "auc", "num_leaves": 15,
+              "learning_rate": 0.1, "tree_learner": "data",
+              "num_machines": 8, "verbosity": -1}
+    cfg = Config(params)
+
+    # single-host baseline
+    ds0 = BinnedDataset.construct_from_matrix(x, cfg, ())
+    ds0.metadata.set_label(y)
+    b0 = create_boosting(cfg)
+    b0.init_train(ds0)
+    for _ in range(10):
+        b0.train_one_iter()
+
+    # pre-partitioned: contiguous row shards, per-shard find-bin
+    cuts = np.linspace(0, len(y), 5).astype(int)
+    shards = [x[cuts[i]:cuts[i + 1]] for i in range(4)]
+    ds1, offsets = construct_pre_partitioned(shards, cfg)
+    assert offsets[-1] == len(y)
+    ds1.metadata.set_label(np.concatenate(
+        [y[cuts[i]:cuts[i + 1]] for i in range(4)]))
+    b1 = create_boosting(cfg)
+    b1.init_train(ds1)
+    for _ in range(10):
+        b1.train_one_iter()
+
+    from sklearn.metrics import roc_auc_score
+    a0 = roc_auc_score(yt, np.asarray(b0.predict(xt, raw_score=True)))
+    a1 = roc_auc_score(yt, np.asarray(b1.predict(xt, raw_score=True)))
+    assert a1 > a0 - 0.01, (a0, a1)
+
+
+def test_misaligned_shards_rejected():
+    with pytest.raises(Exception, match="misaligned"):
+        allgather_mappers([(0, [BinMapper().to_state()]),
+                           (5, [BinMapper().to_state()])])
