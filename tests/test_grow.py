@@ -447,34 +447,40 @@ def test_device_dart_rf_match_host(reg_data, boosting):
                                atol=5e-3)
 
 
-def test_striped_count_columns_match_host(reg_data):
-    """N >= 2^24 rows switches the wave matmul to two striped count
-    columns (hist_cols=4); forced on small data, the device trees must
-    still match the host learner exactly."""
+@pytest.mark.parametrize("extra,striped_cols,plain_cols", [
+    ({}, 4, 3),
+    ({"gpu_use_dp": True}, 6, 5),
+], ids=["plain", "gpu_use_dp"])
+def test_striped_count_columns_match_default(reg_data, extra,
+                                             striped_cols, plain_cols):
+    """N >= COUNT_SPLIT_ROWS switches the wave matmul to two striped
+    count columns (hist_cols 3->4, and 5->6 under gpu_use_dp so the
+    extra-precision path does not reintroduce the single-column count
+    overflow).  Forced on small data, the striped device trees must
+    match the default device layout exactly: identical g/h columns and
+    counts exact in both layouts at this size (the stripe only changes
+    the matmul's column split, summed back before any consumer)."""
     import lightgbm_tpu.ops.grow as growmod
     x, y = reg_data
     params = {"objective": "regression", "num_leaves": 31,
-              "min_data_in_leaf": 20}
+              "min_data_in_leaf": 20, **extra}
     old = growmod.COUNT_SPLIT_ROWS
     try:
-        # force the striped path: threshold <= N < 2x threshold keeps
-        # the configuration device-eligible
+        # threshold <= N < 2x threshold keeps the config device-eligible
         growmod.COUNT_SPLIT_ROWS = 3000
-        bd = _make(params, x, y, True)
-        assert bd._grower is not None and bd._grower.hist_cols == 4
+        bs = _make(params, x, y, True)
+        assert bs._grower is not None
+        assert bs._grower.hist_cols == striped_cols
         growmod.COUNT_SPLIT_ROWS = old
-        b3 = _make(params, x, y, True)
-        assert b3._grower.hist_cols == 3
+        bp = _make(params, x, y, True)
+        assert bp._grower.hist_cols == plain_cols
         for _ in range(5):
-            bd.train_one_iter()
-            b3.train_one_iter()
-        bd._flush_pending()
-        b3._flush_pending()
-        # identical g/h columns; counts exact in both layouts at this
-        # size -> identical trees (the stripe only changes the matmul's
-        # column split, summed back before any consumer)
-        pd = np.asarray(bd.predict(x[:256]))
-        p3 = np.asarray(b3.predict(x[:256]))
-        np.testing.assert_allclose(pd, p3, rtol=1e-5, atol=1e-6)
+            bs.train_one_iter()
+            bp.train_one_iter()
+        bs._flush_pending()
+        bp._flush_pending()
+        np.testing.assert_allclose(np.asarray(bs.predict(x[:256])),
+                                   np.asarray(bp.predict(x[:256])),
+                                   rtol=1e-5, atol=1e-6)
     finally:
         growmod.COUNT_SPLIT_ROWS = old
