@@ -40,6 +40,37 @@ BASELINE_GPU_S = 80.0    # implied ~3x GPU speedup, docs/GPU-Performance.rst
 BASELINE_MSLR_S = 215.32  # docs/Experiments.rst:109-110 (MS LTR, 500 iters)
 
 
+def host_sentinel_ms() -> float:
+    """Timed fixed numpy workload: a self-diagnosing host-load probe.
+
+    The r4 driver run recorded 385 s where an idle host measured 234 s
+    for identical device work — host CPU contention starved the dispatch
+    loop.  Reporting this number alongside the benchmark makes such
+    discrepancies attributable from the JSON alone (idle baseline for
+    this op: ~35-60 ms; a loaded host measures several times that)."""
+    a = np.random.default_rng(0).standard_normal((1024, 1024)) \
+        .astype(np.float32)
+    t0 = time.perf_counter()
+    for _ in range(4):
+        a = a @ a
+        a /= max(float(np.abs(a).max()), 1e-30)
+    return round((time.perf_counter() - t0) * 1e3, 1)
+
+
+def _waves_per_tree(bst):
+    """Mean wave count per tree from the booster's device handles (the
+    fused path stacks one (chunk,) array per dispatch)."""
+    handles = getattr(bst, "_wave_handles", None)
+    if not handles:
+        return None
+    tot = cnt = 0
+    for h in handles:
+        a = np.asarray(h)
+        tot += int(a.sum())
+        cnt += int(a.size)
+    return round(tot / max(cnt, 1), 2)
+
+
 def synth_higgs(rows: int, cols: int = 28, seed: int = 7):
     """Standard-normal features with a planted nonlinear binary signal.
 
@@ -95,27 +126,44 @@ def run_higgs(args) -> dict:
     TRAIN_TIMER.reset()
     TRAIN_TIMER.sync = args.profile
 
-    # warm-up: 2 iterations trigger + cache the XLA compiles.  The SAME
-    # booster is then timed for the remaining iterations (a fresh booster
-    # would re-trace its jitted grower and put the compile back into the
-    # timed region); per-iteration cost does not depend on the iteration
+    sentinel_pre = host_sentinel_ms()
+
+    # warm-up triggers + caches the XLA compile.  The SAME booster is
+    # then timed for the remaining iterations (a fresh booster would
+    # re-trace its jitted grower and put the compile back into the timed
+    # region); per-iteration cost does not depend on the iteration
     # index, so wall-clock extrapolates linearly.
-    t0 = time.perf_counter()
+    #
+    # Default path: K whole iterations fused into one device dispatch
+    # (GBDT.train_chunked) — ONE program to compile, and the timed loop
+    # touches the host once per K trees, so the recorded number tracks
+    # device throughput even on a loaded driver host.
     bst.init_train(ds)
-    warm = min(2, args.iters)
-    for _ in range(warm):
-        bst.train_one_iter()
+    chunk = args.chunk if args.chunk > 1 \
+        and bst._fused_grad_fn() is not None else 0
+    t0 = time.perf_counter()
+    if chunk:
+        warm = min(chunk, args.iters)
+        bst.train_chunked(warm, chunk=chunk)
+    else:
+        warm = min(2, args.iters)
+        for _ in range(warm):
+            bst.train_one_iter()
     jax.block_until_ready(bst.train_score)
     t_warm = time.perf_counter() - t0
 
     # timed region: the remaining iterations
     TRAIN_TIMER.reset()
     t0 = time.perf_counter()
-    for _ in range(args.iters - warm):
-        if bst.train_one_iter():
-            break
+    if chunk:
+        bst.train_chunked(args.iters - warm, chunk=chunk)
+    else:
+        for _ in range(args.iters - warm):
+            if bst.train_one_iter():
+                break
     jax.block_until_ready(bst.train_score)
     timed_s = time.perf_counter() - t0
+    sentinel_post = host_sentinel_ms()
     iters_timed = bst.num_iterations() - warm
     per_iter = timed_s / max(iters_timed, 1)
     train_s = per_iter * bst.num_iterations()   # full-run equivalent
@@ -143,10 +191,7 @@ def run_higgs(args) -> dict:
 
     iters_run = bst.num_iterations()
     phases = {k: round(v, 3) for k, v in sorted(TRAIN_TIMER.acc.items())}
-    waves_per_tree = None
-    if getattr(bst, "_wave_handles", None):
-        tot = sum(int(np.asarray(h)) for h in bst._wave_handles)
-        waves_per_tree = round(tot / len(bst._wave_handles), 2)
+    waves_per_tree = _waves_per_tree(bst)
     if args.profile and getattr(bst, "_grower", None) is not None:
         # per-phase ms for ONE wave's components, separately jitted and
         # synced (the production while_loop hides phases from the host)
@@ -177,6 +222,8 @@ def run_higgs(args) -> dict:
         "gen_s": round(t_gen, 2),
         "bin_s": round(t_bin, 2),
         "warmup_compile_s": round(t_warm, 2),
+        "fused_chunk": chunk,
+        "host_sentinel_ms": [sentinel_pre, sentinel_post],
     }
     return result
 
@@ -258,18 +305,27 @@ def run_mslr(args) -> dict:
     t_bin = time.perf_counter() - t0
 
     bst = create_boosting(cfg)
-    t0 = time.perf_counter()
     bst.init_train(ds)
-    warm = min(2, iters)
-    for _ in range(warm):
-        bst.train_one_iter()
+    chunk = args.chunk if args.chunk > 1 \
+        and bst._fused_grad_fn() is not None else 0
+    t0 = time.perf_counter()
+    if chunk:
+        warm = min(chunk, iters)
+        bst.train_chunked(warm, chunk=chunk)
+    else:
+        warm = min(2, iters)
+        for _ in range(warm):
+            bst.train_one_iter()
     jax.block_until_ready(bst.train_score)
     t_warm = time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    for _ in range(iters - warm):
-        if bst.train_one_iter():
-            break
+    if chunk:
+        bst.train_chunked(iters - warm, chunk=chunk)
+    else:
+        for _ in range(iters - warm):
+            if bst.train_one_iter():
+                break
     jax.block_until_ready(bst.train_score)
     timed_s = time.perf_counter() - t0
     iters_timed = bst.num_iterations() - warm
@@ -306,6 +362,7 @@ def run_mslr(args) -> dict:
         "gen_s": round(t_gen, 2),
         "bin_s": round(t_bin, 2),
         "warmup_compile_s": round(t_warm, 2),
+        "fused_chunk": chunk,
     }
 
 
@@ -322,6 +379,10 @@ def main() -> int:
                          "benchmark setting (docs/GPU-Performance.rst); "
                          "255 matches the CPU run")
     ap.add_argument("--learning-rate", type=float, default=0.1)
+    ap.add_argument("--chunk", type=int,
+                    default=int(os.environ.get("BENCH_CHUNK", 20)),
+                    help="boosting iterations fused per device dispatch "
+                         "(GBDT.train_chunked); 0 = per-iteration path")
     ap.add_argument("--quick", action="store_true",
                     help="1M rows, 50 iterations")
     ap.add_argument("--profile", action="store_true",
@@ -343,6 +404,11 @@ def main() -> int:
     if args.quick:
         args.rows = min(args.rows, 1_000_000)
         args.iters = min(args.iters, 50)
+        args.chunk = min(args.chunk, 10)   # 50 = 10 warm + 4 x 10 timed
+    if args.chunk > 1 and args.iters % args.chunk:
+        # keep every dispatch the same scan length (one compiled program)
+        args.chunk = max(d for d in range(1, args.chunk + 1)
+                         if args.iters % d == 0)
 
     if args.suite == "mslr":
         result = run_mslr(args)

@@ -46,7 +46,53 @@ class _ValidSet:
         self.applied_models = 0     # models already added to `score`
 
 
-class _PendingTree:
+def _replay_records(rec_i, rec_f, rec_c, nl, shrinkage, bias, dataset,
+                    config) -> Tree:
+    """Replay host-side split records of one device-grown tree into a
+    ``Tree`` (rec_i/rec_f/rec_c are numpy, nl an int)."""
+    tree = Tree(config.num_leaves)
+    if nl <= 1:
+        # stump: the grower applied NOTHING to the training scores
+        # (grow.py zeroes the update when nl<=1), so the materialized
+        # tree must carry 0 too — only the boost_from_average bias
+        # (added below) reaches the model, matching the host path at
+        # GBDT.train_one_iter's stump branch
+        tree.leaf_value[0] = 0.0
+    else:
+        from ..tree.tree import categorical_bitsets
+        is_cat_f = np.asarray(dataset.f_is_categorical)
+        for s in range(nl - 1):
+            leaf, right, f, thr, dl = (int(v) for v in rec_i[s])
+            (gain, lg, lh, lc, rg, rh, rc, lout, rout) = (
+                float(v) for v in rec_f[s])
+            real_f = dataset.used_features[f]
+            mapper = dataset.bin_mappers[real_f]
+            missing = int(dataset.f_missing_type[f])
+            if is_cat_f[f]:
+                words = rec_c[s].astype(np.uint32)
+                member_bins = [
+                    b for b in range(min(mapper.num_bin, 256))
+                    if (words[b >> 5] >> (b & 31)) & 1]
+                bitset_inner, bitset = categorical_bitsets(
+                    mapper, member_bins)
+                tree.split_categorical(
+                    leaf, f, real_f, bitset_inner, bitset, lout,
+                    rout, int(lc), int(rc), gain, missing)
+            else:
+                tree.split(leaf, f, real_f, thr,
+                           mapper.bin_to_value(thr), lout, rout,
+                           int(lc), int(rc), gain, missing, bool(dl))
+        tree.apply_shrinkage(shrinkage)
+    if abs(bias) > K_EPSILON:
+        tree.add_bias(bias)
+    return tree
+
+
+class _Pending:
+    """Marker base for lazily-materialized device-grown trees."""
+
+
+class _PendingTree(_Pending):
     """Device-side split records of a tree grown by the DeviceGrower;
     replayed into a host ``Tree`` lazily (``GBDT._flush_pending``)."""
 
@@ -69,46 +115,52 @@ class _PendingTree:
                 pass
 
     def materialize(self, dataset, config) -> Tree:
-        nl = int(np.asarray(self.nl))
-        tree = Tree(config.num_leaves)
-        if nl <= 1:
-            # stump: the grower applied NOTHING to the training scores
-            # (grow.py zeroes the update when nl<=1), so the materialized
-            # tree must carry 0 too — only the boost_from_average bias
-            # (added below) reaches the model, matching the host path at
-            # GBDT.train_one_iter's stump branch
-            tree.leaf_value[0] = 0.0
-        else:
-            from ..tree.tree import categorical_bitsets
-            rec_i = np.asarray(self.rec_i)
-            rec_f = np.asarray(self.rec_f)
-            rec_c = np.asarray(self.rec_c)
-            is_cat_f = np.asarray(dataset.f_is_categorical)
-            for s in range(nl - 1):
-                leaf, right, f, thr, dl = (int(v) for v in rec_i[s])
-                (gain, lg, lh, lc, rg, rh, rc, lout, rout) = (
-                    float(v) for v in rec_f[s])
-                real_f = dataset.used_features[f]
-                mapper = dataset.bin_mappers[real_f]
-                missing = int(dataset.f_missing_type[f])
-                if is_cat_f[f]:
-                    words = rec_c[s].astype(np.uint32)
-                    member_bins = [
-                        b for b in range(min(mapper.num_bin, 256))
-                        if (words[b >> 5] >> (b & 31)) & 1]
-                    bitset_inner, bitset = categorical_bitsets(
-                        mapper, member_bins)
-                    tree.split_categorical(
-                        leaf, f, real_f, bitset_inner, bitset, lout,
-                        rout, int(lc), int(rc), gain, missing)
-                else:
-                    tree.split(leaf, f, real_f, thr,
-                               mapper.bin_to_value(thr), lout, rout,
-                               int(lc), int(rc), gain, missing, bool(dl))
-            tree.apply_shrinkage(self.shrinkage)
-        if abs(self.bias) > K_EPSILON:
-            tree.add_bias(self.bias)
-        return tree
+        return _replay_records(np.asarray(self.rec_i),
+                               np.asarray(self.rec_f),
+                               np.asarray(self.rec_c),
+                               int(np.asarray(self.nl)),
+                               self.shrinkage, self.bias, dataset, config)
+
+
+class _RecStack:
+    """Stacked split records of a fused chunk of trees
+    (``DeviceGrower.fused_train`` output): ONE async device->host copy
+    serves every tree in the chunk."""
+
+    __slots__ = ("arrs", "_host")
+
+    def __init__(self, rec_i, rec_f, rec_c, nl):
+        self.arrs = (rec_i, rec_f, rec_c, nl)
+        self._host = None
+        for a in self.arrs:
+            try:
+                a.copy_to_host_async()
+            except AttributeError:
+                pass
+
+    def host(self):
+        if self._host is None:
+            self._host = tuple(np.asarray(a) for a in self.arrs)
+            self.arrs = None
+        return self._host
+
+
+class _PendingChunkTree(_Pending):
+    """One tree of a fused chunk: index ``idx`` into a shared _RecStack."""
+
+    __slots__ = ("stack", "idx", "shrinkage", "bias")
+
+    def __init__(self, stack, idx, shrinkage, bias):
+        self.stack = stack
+        self.idx = idx
+        self.shrinkage = shrinkage
+        self.bias = bias
+
+    def materialize(self, dataset, config) -> Tree:
+        rec_i, rec_f, rec_c, nl = self.stack.host()
+        return _replay_records(rec_i[self.idx], rec_f[self.idx],
+                               rec_c[self.idx], int(nl[self.idx]),
+                               self.shrinkage, self.bias, dataset, config)
 
 
 class GBDT:
@@ -138,10 +190,17 @@ class GBDT:
         self._device_stop = False
         self._nl_queue: List = []   # in-flight num_leaves handles (lagged)
         self._wave_handles: List = []  # per-iter wave counts (device scalars)
+        self._fused_grad = False    # cached objective.device_grad() result
+        self._last_chunk_stack = None   # previous fused chunk's _RecStack
 
     # ------------------------------------------------------------------
     def init_train(self, train_set: BinnedDataset, objective=None):
         cfg = self.config
+        # re-init invalidates the fused-path caches (gargs hold the OLD
+        # dataset's label arrays; a stale stall stack would trip the
+        # first chunk's lagged check)
+        self._fused_grad = False
+        self._last_chunk_stack = None
         self.train_set = train_set
         self.objective = objective if objective is not None \
             else create_objective(cfg)
@@ -423,6 +482,86 @@ class GBDT:
                 return True
         return False
 
+    # ------------------------------------------------------------------
+    # fused multi-iteration device path: K whole boosting iterations per
+    # dispatch (lax.scan over trees, gradients computed on device)
+    def _fused_grad_fn(self):
+        """(grad_fn, gargs) when fused multi-iteration training is sound
+        for the CURRENT state, else None.  Sound means: plain GBDT (no
+        DART/GOSS/RF overrides), single model, no bagging, full
+        feature_fraction, and an objective exposing a pure device
+        gradient."""
+        if (self._grower is None or type(self) is not GBDT
+                or self.num_model != 1 or self.need_bagging
+                or self.config.feature_fraction < 1.0
+                or self.train_set.num_features == 0
+                or self.objective is None
+                or not self.class_need_train[0]):
+            return None
+        if self._fused_grad is False:
+            self._fused_grad = self.objective.device_grad()
+        return self._fused_grad
+
+    def train_chunked(self, n_iters: int, chunk: int = 20) -> bool:
+        """Train ``n_iters`` boosting iterations, fusing ``chunk`` whole
+        iterations into one device dispatch when the configuration
+        allows; otherwise falls back to per-iteration training.  Returns
+        True when training stopped early (no more splittable leaves).
+
+        The fused path exists because the per-iteration driver loop is
+        host-latency-bound under CPU contention (each tree takes ~5
+        Python-side steps); one dispatch per ``chunk`` trees keeps the
+        device fed regardless of host load.  Semantics match the
+        per-iteration device path: same gradients, same trees, same
+        scores; the stall check lags by one chunk instead of 4
+        iterations, and ``_flush_pending`` trims trailing stump
+        iterations exactly as before.
+        """
+        fg = self._fused_grad_fn()
+        if fg is None or chunk <= 1:
+            for _ in range(n_iters):
+                if self.train_one_iter():
+                    return True
+            return False
+        grad_fn, gargs = fg
+        lr = jnp.asarray(self.shrinkage_rate * self._tree_multiplier(),
+                         jnp.float32)
+        mask = self.learner._feature_mask()   # all ones (ff == 1.0)
+        done = 0
+        while done < n_iters:
+            if self._device_stop:
+                return True
+            k = min(chunk, n_iters - done)
+            if k < chunk:
+                # remainder: per-iteration path (a second scan length
+                # would cost a fresh XLA compile of the whole program)
+                for _ in range(k):
+                    if self.train_one_iter():
+                        return True
+                return False
+            bias = self.boost_from_average(0) if not self.models else 0.0
+            fused = self._grower.fused_train(chunk)
+            score, (rec_i, rec_f, rec_c, nl, _root, waves) = fused(
+                self._grower.binned, self._grower.binned_t,
+                self.train_score[0], mask, lr, gargs, grad_fn=grad_fn)
+            self.train_score = self.train_score.at[0].set(score)
+            stack = _RecStack(rec_i, rec_f, rec_c, nl)
+            for i in range(chunk):
+                self.models.append(_PendingChunkTree(
+                    stack, i, self.shrinkage_rate * self._tree_multiplier(),
+                    bias if i == 0 else 0.0))
+            self._wave_handles.append(waves)
+            self.iter += chunk
+            done += chunk
+            # lagged stall check: the PREVIOUS chunk's records have
+            # landed by now (this chunk is seconds of device work), so
+            # reading them never blocks the dispatch pipeline
+            prev, self._last_chunk_stack = self._last_chunk_stack, stack
+            if prev is not None and (prev.host()[3] <= 1).all():
+                self._trim_device_stumps()
+                return True
+        return False
+
     def _trim_device_stumps(self):
         """Remove trailing stump iterations (the device path keeps
         dispatching until the lagged check notices training stalled).
@@ -443,7 +582,7 @@ class GBDT:
         stall check) to keep predict()/save consistent with the training
         scores no matter when training stopped."""
         for i, m in enumerate(self.models):
-            if isinstance(m, _PendingTree):
+            if isinstance(m, _Pending):
                 self.models[i] = m.materialize(self.train_set, self.config)
         if self._grower is not None:
             nm = max(self.num_model, 1)

@@ -38,6 +38,18 @@ class ObjectiveFunction:
     def get_gradients(self, scores) -> Tuple[jnp.ndarray, jnp.ndarray]:
         raise NotImplementedError
 
+    def device_grad(self):
+        """Pure-jnp gradient for fusing into a device-resident training
+        loop (``DeviceGrower.fused_train``): returns ``(fn, args)`` where
+        ``fn(score_1d, args) -> (grad, hess)`` is safe to trace inside
+        jit/scan — no host work, and every array it reads arrives through
+        ``args`` (a pytree passed as a jit argument; a closed-over device
+        array would be baked into the compile request as a constant).
+        Returns None when the objective has no fusable single-model
+        formulation (multi-model, renewal, host-side state).
+        """
+        return None
+
     def boost_from_score(self, class_id: int) -> float:
         """Initial score (BoostFromScore)."""
         return 0.0

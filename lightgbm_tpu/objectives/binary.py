@@ -67,6 +67,17 @@ class BinaryLogloss(ObjectiveFunction):
         return self._grad(scores[0].astype(jnp.float32), self.sign_label_d,
                           self.label_weight_d, self.weights_d)
 
+    def device_grad(self):
+        if not self.need_train:
+            return None
+
+        def fn(score, args):
+            # _grad inlines when traced inside the fused scan, so the
+            # fused and per-iteration paths share one formula
+            return self._grad(score, *args)
+
+        return fn, (self.sign_label_d, self.label_weight_d, self.weights_d)
+
     def boost_from_score(self, class_id):
         is_pos = (self.label > 0).astype(np.float64)
         if self.weights is not None:

@@ -195,5 +195,23 @@ class LambdarankNDCG(ObjectiveFunction):
             hess = hess * self.weights_d
         return grad, hess
 
+    def device_grad(self):
+        batches = self._grad_batches   # static ints, safe to close over
+
+        def fn(score, args):
+            # shares _all_grads with the per-iteration path (inlines
+            # when traced inside the fused scan)
+            bucket_arrays, inv_perm, weights = args
+            score_ext = jnp.concatenate(
+                [score, jnp.zeros(1, jnp.float32)])
+            gh = self._all_grads(score_ext, bucket_arrays, batches,
+                                 inv_perm)
+            g, h = gh[:, 0], gh[:, 1]
+            if weights is not None:
+                g, h = g * weights, h * weights
+            return g, h
+
+        return fn, (self._grad_arrays, self._inv_perm, self.weights_d)
+
     def to_string(self):
         return self.name

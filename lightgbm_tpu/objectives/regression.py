@@ -50,6 +50,18 @@ class RegressionL2(ObjectiveFunction):
         return self._grad(scores[0].astype(jnp.float32), self.label_d,
                           self.weights_d)
 
+    def device_grad(self):
+        # subclasses (L1/Huber/...) override gradients; only plain L2 is
+        # known to be this formula
+        if type(self) is not RegressionL2:
+            return None
+
+        def fn(score, args):
+            # shares _grad with the per-iteration path (inlines in-scan)
+            return self._grad(score, *args)
+
+        return fn, (self.label_d, self.weights_d)
+
     def boost_from_score(self, class_id):
         if self.weights is None:
             return float(np.mean(self.label))

@@ -194,6 +194,7 @@ class DeviceGrower:
                                                with_mask=False))
         self._grow_masked = jax.jit(functools.partial(self._grow_impl,
                                                       with_mask=True))
+        self._fused = {}   # scan length -> jitted multi-iteration program
 
     # ------------------------------------------------------------------
     # wave histogram: one dense pass for up to W pending leaves
@@ -604,6 +605,53 @@ class DeviceGrower:
                                  hess, feature_mask,
                                  jnp.asarray(lr, jnp.float32), row_mask)
 
+
+    # ------------------------------------------------------------------
+    def fused_train(self, length: int):
+        """Jitted program running ``length`` whole boosting iterations in
+        ONE device dispatch: gradients -> tree growth -> score update
+        inside a ``lax.scan`` over iterations.
+
+        Motivation: the per-iteration path needs ~5 host-side steps per
+        tree (gradient dispatch, grow dispatch, score set, record
+        copies), and on a loaded host that Python loop starves the
+        device — the driver-recorded HIGGS run measured 771 ms/tree vs
+        468 ms/tree idle-host for identical device work.  Fusing K
+        iterations amortizes every host touch 1/K and makes wall-clock
+        track device throughput.
+
+        Signature of the returned program::
+
+            run(binned, binned_t, score, feature_mask, lr, gargs,
+                grad_fn=fn)
+            -> (final_score,
+                (rec_i (K,L-1,5), rec_f (K,L-1,9), rec_c (K,L-1,8),
+                 nl (K,), root_value (K,), waves (K,)))
+
+        ``grad_fn(score, gargs) -> (grad, hess)`` comes from
+        ``ObjectiveFunction.device_grad`` (pure jnp; all arrays via
+        ``gargs``).  Compiled once per (length, grad_fn) pair — callers
+        must reuse one grad_fn instance to hit the jit cache.
+        """
+        if length not in self._fused:
+            def run(binned, binned_t, score, feature_mask, lr, gargs,
+                    grad_fn):
+                no_mask = jnp.zeros((0,), jnp.float32)
+
+                def body(sc, _):
+                    g, h = grad_fn(sc, gargs)
+                    (new_score, rec_i, rec_f, rec_c, nl, root, waves) = \
+                        self._grow_impl(binned, binned_t, sc, g, h,
+                                        feature_mask, lr, no_mask,
+                                        with_mask=False)
+                    return new_score, (rec_i, rec_f, rec_c, nl, root,
+                                       waves)
+
+                return jax.lax.scan(body, score, None, length=length)
+
+            self._fused[length] = jax.jit(run,
+                                          static_argnames=("grad_fn",))
+        return self._fused[length]
 
     # ------------------------------------------------------------------
     def profile_phases(self, grad, hess, reps: int = 20) -> dict:

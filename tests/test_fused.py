@@ -1,0 +1,136 @@
+"""Fused multi-iteration device training (GBDT.train_chunked).
+
+The fused path runs K whole boosting iterations per device dispatch
+(gradients computed inside the scan, ops/grow.py fused_train); these
+tests pin that it trains THE SAME model as the per-iteration device
+path, falls back when ineligible, and stops on stump stalls.
+"""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.boosting import create_boosting
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.data.dataset import BinnedDataset
+
+
+def _binary_data(rows=3000, cols=10, seed=3):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((rows, cols)).astype(np.float32)
+    logit = x[:, 0] + np.abs(x[:, 1]) - 0.5 * x[:, 2]
+    y = (rng.random(rows) < 1 / (1 + np.exp(-logit))).astype(np.float32)
+    return x, y
+
+
+def _rank_data(rows=1200, cols=8, seed=5):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((rows, cols)).astype(np.float32)
+    sizes = []
+    left = rows
+    while left > 0:
+        s = min(int(rng.integers(5, 40)), left)
+        sizes.append(s)
+        left -= s
+    util = x[:, 0] + 0.5 * np.abs(x[:, 1]) + rng.standard_normal(rows)
+    y = np.digitize(util, np.quantile(util, [0.6, 0.85, 0.96]))
+    return x, y.astype(np.float32), np.asarray(sizes, np.int64)
+
+
+def _train(params, x, y, n_iters, chunk=0, query=None):
+    cfg = Config({"verbosity": -1, "device_growth": "on",
+                  "num_leaves": 15, "min_data_in_leaf": 5, **params})
+    ds = BinnedDataset.construct_from_matrix(x, cfg)
+    ds.metadata.set_label(y)
+    if query is not None:
+        ds.metadata.set_query(query)
+    bst = create_boosting(cfg)
+    bst.init_train(ds)
+    if chunk:
+        bst.train_chunked(n_iters, chunk=chunk)
+    else:
+        for _ in range(n_iters):
+            if bst.train_one_iter():
+                break
+    bst._flush_pending()
+    return bst
+
+
+def _assert_same_models(a, b):
+    assert len(a.models) == len(b.models)
+    for ta, tb in zip(a.models, b.models):
+        assert ta.num_leaves == tb.num_leaves
+        np.testing.assert_array_equal(
+            ta.split_feature[:ta.num_leaves - 1],
+            tb.split_feature[:tb.num_leaves - 1])
+        np.testing.assert_allclose(
+            ta.leaf_value[:ta.num_leaves],
+            tb.leaf_value[:tb.num_leaves], rtol=2e-4, atol=1e-6)
+
+
+def test_binary_chunked_matches_per_iter():
+    x, y = _binary_data()
+    a = _train({"objective": "binary"}, x, y, 12)
+    b = _train({"objective": "binary"}, x, y, 12, chunk=4)
+    _assert_same_models(a, b)
+    np.testing.assert_allclose(np.asarray(a.train_score),
+                               np.asarray(b.train_score),
+                               rtol=2e-4, atol=1e-5)
+
+
+def test_binary_chunk_remainder_uses_per_iter_path():
+    # 10 = 2 chunks of 4 + remainder 2 via train_one_iter
+    x, y = _binary_data(rows=1500)
+    a = _train({"objective": "binary"}, x, y, 10)
+    b = _train({"objective": "binary"}, x, y, 10, chunk=4)
+    _assert_same_models(a, b)
+
+
+def test_regression_chunked_matches_per_iter():
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((2000, 8)).astype(np.float32)
+    y = (x[:, 0] * 2 + np.abs(x[:, 1])
+         + 0.1 * rng.standard_normal(2000)).astype(np.float32)
+    a = _train({"objective": "regression"}, x, y, 8)
+    b = _train({"objective": "regression"}, x, y, 8, chunk=4)
+    _assert_same_models(a, b)
+
+
+def test_lambdarank_chunked_matches_per_iter():
+    x, y, q = _rank_data()
+    a = _train({"objective": "lambdarank"}, x, y, 8, query=q)
+    b = _train({"objective": "lambdarank"}, x, y, 8, chunk=4, query=q)
+    _assert_same_models(a, b)
+
+
+def test_ineligible_config_falls_back():
+    # bagging makes the fused path unsound; train_chunked must still
+    # train correctly via the per-iteration path
+    x, y = _binary_data(rows=1500)
+    params = {"objective": "binary", "bagging_fraction": 0.7,
+              "bagging_freq": 1}
+    a = _train(params, x, y, 6)
+    b = _train(params, x, y, 6, chunk=3)
+    _assert_same_models(a, b)
+    cfg_bst = _train(params, x, y, 0)
+    assert cfg_bst._fused_grad_fn() is None
+
+
+def test_chunked_stump_stall_stops():
+    # constant labels: zero gradients after boost_from_average -> every
+    # tree is a stump -> the lagged chunk check must stop training and
+    # trim to the single bias-carrying stump (host-path semantics)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((500, 5)).astype(np.float32)
+    y = np.full(500, 3.25, np.float32)
+    bst = _train({"objective": "regression"}, x, y, 12, chunk=4)
+    assert len(bst.models) == 1
+    assert bst.models[0].num_leaves == 1
+    pred = bst.predict(x[:8])
+    np.testing.assert_allclose(pred, 3.25, rtol=1e-6)
+
+
+def test_fused_grad_objectives_exposed():
+    from lightgbm_tpu.objectives import create_objective
+    for obj_name in ("binary", "regression", "lambdarank"):
+        cfg = Config({"objective": obj_name})
+        assert create_objective(cfg) is not None
