@@ -1,0 +1,90 @@
+/*!
+ * lightgbm_tpu native ABI — the subset of the fork's C/C++ API surface
+ * that its cache-admission harness consumes
+ * (reference: /root/reference/include/LightGBM/c_api.h:38,144-160,
+ *  271,293-300,341-346,374,430,591-600,621-640,715-720 and the call
+ *  sites in /root/reference/src/test.cpp:243-298).
+ *
+ * Signatures match the fork's header verbatim, including its
+ * std::unordered_map<std::string, std::string> parameter passing (the
+ * fork patched the upstream plain-C signatures to C++ maps), so
+ * test.cpp-shaped code compiles against this header unchanged and links
+ * against liblgbm_tpu.so, which embeds CPython and executes the
+ * lightgbm_tpu runtime.
+ */
+#ifndef LIGHTGBM_TPU_C_API_H_
+#define LIGHTGBM_TPU_C_API_H_
+
+#include <cstdint>
+#include <string>
+#include <unordered_map>
+
+#define LIGHTGBM_C_EXPORT extern "C" __attribute__((visibility("default")))
+#define LIGHTGBM_CPP_EXPORT __attribute__((visibility("default")))
+
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+
+#define C_API_DTYPE_FLOAT32 (0)
+#define C_API_DTYPE_FLOAT64 (1)
+#define C_API_DTYPE_INT32   (2)
+#define C_API_DTYPE_INT64   (3)
+
+#define C_API_PREDICT_NORMAL     (0)
+#define C_API_PREDICT_RAW_SCORE  (1)
+#define C_API_PREDICT_LEAF_INDEX (2)
+#define C_API_PREDICT_CONTRIB    (3)
+
+LIGHTGBM_C_EXPORT const char* LGBM_GetLastError();
+
+/* unordered_map parameters => C++ linkage, like the fork's header */
+LIGHTGBM_CPP_EXPORT int LGBM_DatasetCreateFromCSR(
+    const void* indptr, int indptr_type, const int32_t* indices,
+    const void* data, int data_type, int64_t nindptr, int64_t nelem,
+    int64_t num_col,
+    std::unordered_map<std::string, std::string> parameters,
+    const DatasetHandle reference, DatasetHandle* out);
+
+LIGHTGBM_C_EXPORT int LGBM_DatasetSetField(DatasetHandle handle,
+                                           const char* field_name,
+                                           const void* field_data,
+                                           int num_element, int type);
+
+LIGHTGBM_C_EXPORT int LGBM_DatasetGetNumData(DatasetHandle handle,
+                                             int64_t* out);
+
+LIGHTGBM_C_EXPORT int LGBM_DatasetFree(DatasetHandle handle);
+
+LIGHTGBM_CPP_EXPORT int LGBM_BoosterCreate(
+    const DatasetHandle train_data,
+    std::unordered_map<std::string, std::string> parameters,
+    BoosterHandle* out);
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterFree(BoosterHandle handle);
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterUpdateOneIter(BoosterHandle handle,
+                                                int* is_finished);
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterGetCurrentIteration(
+    BoosterHandle handle, int64_t* out_iteration);
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterCalcNumPredict(BoosterHandle handle,
+                                                 int num_row,
+                                                 int predict_type,
+                                                 int num_iteration,
+                                                 int64_t* out_len);
+
+LIGHTGBM_CPP_EXPORT int LGBM_BoosterPredictForCSR(
+    BoosterHandle handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t num_col, int predict_type,
+    int num_iteration,
+    std::unordered_map<std::string, std::string> parameter,
+    int64_t* out_len, double* out_result);
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterSaveModel(BoosterHandle handle,
+                                            int start_iteration,
+                                            int num_iteration,
+                                            const char* filename);
+
+#endif  /* LIGHTGBM_TPU_C_API_H_ */

@@ -1,0 +1,116 @@
+"""Flat adapters for the native ``liblgbm_tpu`` shared library.
+
+``src/capi/lgbm_capi.cpp`` embeds CPython and calls these functions to
+implement the fork's C/C++ ABI (``/root/reference/include/LightGBM/
+c_api.h:38-815``): each adapter takes memoryviews over the CALLER'S
+buffers plus plain ints/strings, forwards to the ``c_api.py``
+compatibility layer, and RAISES on failure — the C++ layer converts the
+exception into the C return-code convention (0 ok / -1 + message via
+``LGBM_GetLastError``).
+
+Zero-copy discipline: input pointers arrive as read-only memoryviews
+(``np.frombuffer`` wraps them without copying); prediction output is
+written directly into the caller's pre-allocated buffer through a
+writable memoryview.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import c_api as C
+
+
+def _arr(mv, dtype_const):
+    return np.frombuffer(mv, dtype=C._DTYPE_MAP[dtype_const])
+
+
+def _call(fn, *args):
+    if fn(*args) != 0:
+        raise RuntimeError(C.LGBM_GetLastError())
+
+
+def dataset_from_csr(indptr_mv, indptr_type, indices_mv, data_mv,
+                     data_type, nindptr, nelem, num_col, params,
+                     ref_handle):
+    out = C.Ref()
+    _call(C.LGBM_DatasetCreateFromCSR,
+          _arr(indptr_mv, indptr_type), indptr_type,
+          _arr(indices_mv, C.C_API_DTYPE_INT32),
+          _arr(data_mv, data_type), data_type,
+          int(nindptr), int(nelem), int(num_col), params,
+          ref_handle or None, out)
+    return int(out.value)
+
+
+def dataset_from_mat(data_mv, data_type, nrow, ncol, is_row_major,
+                     params, ref_handle):
+    out = C.Ref()
+    _call(C.LGBM_DatasetCreateFromMat, _arr(data_mv, data_type),
+          data_type, int(nrow), int(ncol), int(is_row_major), params,
+          ref_handle or None, out)
+    return int(out.value)
+
+
+def dataset_set_field(handle, field_name, field_mv, num_element, type_):
+    _call(C.LGBM_DatasetSetField, handle, field_name,
+          _arr(field_mv, type_), int(num_element), type_)
+
+
+def dataset_num_data(handle):
+    out = C.Ref()
+    _call(C.LGBM_DatasetGetNumData, handle, out)
+    return int(out.value)
+
+
+def dataset_free(handle):
+    _call(C.LGBM_DatasetFree, handle)
+
+
+def booster_create(train_handle, params):
+    out = C.Ref()
+    _call(C.LGBM_BoosterCreate, train_handle, params, out)
+    return int(out.value)
+
+
+def booster_free(handle):
+    _call(C.LGBM_BoosterFree, handle)
+
+
+def booster_update_one_iter(handle):
+    fin = C.Ref()
+    _call(C.LGBM_BoosterUpdateOneIter, handle, fin)
+    return int(fin.value)
+
+
+def booster_calc_num_predict(handle, num_row, predict_type,
+                             num_iteration):
+    out = C.Ref()
+    _call(C.LGBM_BoosterCalcNumPredict, handle, int(num_row),
+          predict_type, num_iteration, out)
+    return int(out.value)
+
+
+def booster_predict_for_csr(handle, indptr_mv, indptr_type, indices_mv,
+                            data_mv, data_type, nindptr, nelem, num_col,
+                            predict_type, num_iteration, params, out_mv):
+    out_len = C.Ref()
+    out_arr = np.frombuffer(out_mv, np.float64)
+    _call(C.LGBM_BoosterPredictForCSR, handle,
+          _arr(indptr_mv, indptr_type), indptr_type,
+          _arr(indices_mv, C.C_API_DTYPE_INT32),
+          _arr(data_mv, data_type), data_type,
+          int(nindptr), int(nelem), int(num_col), predict_type,
+          num_iteration, params, out_len, out_arr)
+    return int(out_len.value)
+
+
+def booster_save_model(handle, start_iteration, num_iteration, filename):
+    _call(C.LGBM_BoosterSaveModel, handle, start_iteration,
+          num_iteration, filename)
+
+
+def booster_current_iteration(handle):
+    out = C.Ref()
+    _call(C.LGBM_BoosterGetCurrentIteration, handle, out)
+    return int(out.value)

@@ -130,7 +130,19 @@ def test_chunked_stump_stall_stops():
 
 
 def test_fused_grad_objectives_exposed():
+    # the fused path exists iff device_grad() returns a (fn, args) pair
+    # after init — pin that for the three covered objectives
     from lightgbm_tpu.objectives import create_objective
-    for obj_name in ("binary", "regression", "lambdarank"):
-        cfg = Config({"objective": obj_name})
-        assert create_objective(cfg) is not None
+    x, y = _binary_data(rows=200)
+    cfg = Config({"objective": "binary"})
+    ds = BinnedDataset.construct_from_matrix(x, cfg)
+    ds.metadata.set_label(y)
+    for obj_name, query in (("binary", None), ("regression", None),
+                            ("lambdarank", np.asarray([120, 80],
+                                                      np.int64))):
+        if query is not None:
+            ds.metadata.set_query(query)
+        obj = create_objective(Config({"objective": obj_name}))
+        obj.init(ds.metadata, ds.num_data)
+        fg = obj.device_grad()
+        assert fg is not None and callable(fg[0]), obj_name
