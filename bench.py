@@ -57,6 +57,38 @@ def host_sentinel_ms() -> float:
     return round((time.perf_counter() - t0) * 1e3, 1)
 
 
+def timed_train(bst, iters: int, chunk_arg: int):
+    """Warm-up + timed training loop shared by every suite.
+
+    Returns (chunk_used, warm_iters, warmup_s, timed_s, iters_timed).
+    Fused path (train_chunked) when the booster supports it; the warm-up
+    burns exactly one chunk so every later dispatch hits the jit cache.
+    """
+    import jax
+    chunk = chunk_arg if chunk_arg > 1 and bst.fused_eligible() else 0
+    t0 = time.perf_counter()
+    if chunk:
+        warm = min(chunk, iters)
+        bst.train_chunked(warm, chunk=chunk)
+    else:
+        warm = min(2, iters)
+        for _ in range(warm):
+            bst.train_one_iter()
+    jax.block_until_ready(bst.train_score)
+    warmup_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    if chunk:
+        bst.train_chunked(iters - warm, chunk=chunk)
+    else:
+        for _ in range(iters - warm):
+            if bst.train_one_iter():
+                break
+    jax.block_until_ready(bst.train_score)
+    timed_s = time.perf_counter() - t0
+    return chunk, warm, warmup_s, timed_s, bst.num_iterations() - warm
+
+
 def _waves_per_tree(bst):
     """Mean wave count per tree from the booster's device handles (the
     fused path stacks one (chunk,) array per dispatch)."""
@@ -88,6 +120,31 @@ def synth_higgs(rows: int, cols: int = 28, seed: int = 7):
     return x, y
 
 
+def synth_higgs_device(rows: int, cols: int = 28, seed: int = 7):
+    """synth_higgs generated ON DEVICE: the bulk matrix never exists on
+    host, so data generation is immune to driver-host CPU contention
+    (r4's loaded-host run spent 26.9 s here vs 7.6 s idle).  Same
+    planted-concept construction; jax.random instead of numpy."""
+    import jax
+    import jax.numpy as jnp
+    wrng = np.random.default_rng(20260730)
+    w1 = jnp.asarray(wrng.standard_normal(cols).astype(np.float32)
+                     / np.sqrt(cols))
+    w2 = jnp.asarray(wrng.standard_normal(cols).astype(np.float32)
+                     / np.sqrt(cols))
+    @jax.jit
+    def gen(key, w1_, w2_):
+        kx, ky = jax.random.split(key)
+        x = jax.random.normal(kx, (rows, cols), jnp.float32)
+        logits = (x @ w1_) + jnp.abs(x @ w2_) - 0.79
+        p = 1.0 / (1.0 + jnp.exp(-2.0 * logits))
+        y = (jax.random.uniform(ky, (rows,)) < p).astype(jnp.float32)
+        return x, y
+
+    x, y = gen(jax.random.PRNGKey(seed), w1, w2)
+    return x, np.asarray(y, np.float32)
+
+
 def run_higgs(args) -> dict:
     import jax
     from lightgbm_tpu.boosting import create_boosting
@@ -100,10 +157,16 @@ def run_higgs(args) -> dict:
     dev = str(jax.devices()[0])
 
     t0 = time.perf_counter()
-    x, y = synth_higgs(args.rows)
-    xt = yt = None
-    if args.eval_rows > 0:
-        xt, yt = synth_higgs(args.eval_rows, seed=1234)
+    if args.host_data:
+        x, y = synth_higgs(args.rows)
+        xt = yt = None
+        if args.eval_rows > 0:
+            xt, yt = synth_higgs(args.eval_rows, seed=1234)
+    else:
+        x, y = synth_higgs_device(args.rows)
+        xt = yt = None
+        if args.eval_rows > 0:
+            xt, yt = synth_higgs_device(args.eval_rows, seed=1234)
     t_gen = time.perf_counter() - t0
 
     cfg = Config({
@@ -118,7 +181,11 @@ def run_higgs(args) -> dict:
     })
 
     t0 = time.perf_counter()
-    ds = BinnedDataset.construct_from_matrix(x, cfg)
+    if args.host_data:
+        ds = BinnedDataset.construct_from_matrix(x, cfg)
+    else:
+        ds = BinnedDataset.construct_from_device_matrix(x, cfg)
+        jax.block_until_ready(ds.binned)
     ds.metadata.set_label(y)
     t_bin = time.perf_counter() - t0
 
@@ -140,31 +207,12 @@ def run_higgs(args) -> dict:
     # device throughput even on a loaded driver host.
     t0 = time.perf_counter()
     bst.init_train(ds)
-    chunk = args.chunk if args.chunk > 1 \
-        and bst._fused_grad_fn() is not None else 0
-    if chunk:
-        warm = min(chunk, args.iters)
-        bst.train_chunked(warm, chunk=chunk)
-    else:
-        warm = min(2, args.iters)
-        for _ in range(warm):
-            bst.train_one_iter()
-    jax.block_until_ready(bst.train_score)
-    t_warm = time.perf_counter() - t0
-
-    # timed region: the remaining iterations
+    t_init = time.perf_counter() - t0
     TRAIN_TIMER.reset()
-    t0 = time.perf_counter()
-    if chunk:
-        bst.train_chunked(args.iters - warm, chunk=chunk)
-    else:
-        for _ in range(args.iters - warm):
-            if bst.train_one_iter():
-                break
-    jax.block_until_ready(bst.train_score)
-    timed_s = time.perf_counter() - t0
+    chunk, warm, t_warm, timed_s, iters_timed = timed_train(
+        bst, args.iters, args.chunk)
+    t_warm += t_init
     sentinel_post = host_sentinel_ms()
-    iters_timed = bst.num_iterations() - warm
     per_iter = timed_s / max(iters_timed, 1)
     train_s = per_iter * bst.num_iterations()   # full-run equivalent
 
@@ -173,7 +221,12 @@ def run_higgs(args) -> dict:
         from lightgbm_tpu.ops.traverse import add_tree_score, device_tree
         import jax.numpy as jnp
         bst._flush_pending()
-        vds = BinnedDataset.construct_from_matrix(xt, cfg, reference=ds)
+        if args.host_data:
+            vds = BinnedDataset.construct_from_matrix(xt, cfg,
+                                                      reference=ds)
+        else:
+            vds = BinnedDataset.construct_from_device_matrix(
+                xt, cfg, reference=ds)
         binned_d = jnp.asarray(vds.binned)
         score = jnp.zeros(args.eval_rows, jnp.float32)
         for tree in bst.models:
@@ -307,28 +360,10 @@ def run_mslr(args) -> dict:
     bst = create_boosting(cfg)
     t0 = time.perf_counter()
     bst.init_train(ds)
-    chunk = args.chunk if args.chunk > 1 \
-        and bst._fused_grad_fn() is not None else 0
-    if chunk:
-        warm = min(chunk, iters)
-        bst.train_chunked(warm, chunk=chunk)
-    else:
-        warm = min(2, iters)
-        for _ in range(warm):
-            bst.train_one_iter()
-    jax.block_until_ready(bst.train_score)
-    t_warm = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    if chunk:
-        bst.train_chunked(iters - warm, chunk=chunk)
-    else:
-        for _ in range(iters - warm):
-            if bst.train_one_iter():
-                break
-    jax.block_until_ready(bst.train_score)
-    timed_s = time.perf_counter() - t0
-    iters_timed = bst.num_iterations() - warm
+    t_init = time.perf_counter() - t0
+    chunk, warm, t_warm, timed_s, iters_timed = timed_train(
+        bst, iters, args.chunk)
+    t_warm += t_init
     per_iter = timed_s / max(iters_timed, 1)
     train_s = per_iter * bst.num_iterations()
 
@@ -385,6 +420,12 @@ def main() -> int:
                          "(GBDT.train_chunked); 0 = per-iteration path")
     ap.add_argument("--quick", action="store_true",
                     help="1M rows, 50 iterations")
+    ap.add_argument("--host-data", action="store_true",
+                    default=bool(int(os.environ.get("BENCH_HOST_DATA",
+                                                    "0"))),
+                    help="generate + bin the HIGGS data on host (the "
+                         "r4 path); default generates and bins on "
+                         "device")
     ap.add_argument("--profile", action="store_true",
                     default=bool(int(os.environ.get("BENCH_PROFILE", "0"))),
                     help="block per phase for honest phase attribution "

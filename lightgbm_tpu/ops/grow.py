@@ -122,12 +122,22 @@ class DeviceGrower:
         self.num_slots = self.num_groups * nb
 
         self.n_pad = _ceil_to(max(self.num_data, _CHUNK), _CHUNK)
-        binned = np.asarray(dataset.binned)  # (N, G) uint8
         pad = self.n_pad - self.num_data
-        if pad:
-            binned = np.pad(binned, ((0, pad), (0, 0)))
-        self.binned = jnp.asarray(binned)
-        self.binned_t = jnp.asarray(np.ascontiguousarray(binned.T))
+        if getattr(dataset, "device_binned", False):
+            # matrix already lives in HBM (construct_from_device_matrix)
+            binned_d = dataset.binned
+            if pad:
+                binned_d = jnp.pad(binned_d, ((0, pad), (0, 0)))
+            self.binned = binned_d
+        else:
+            binned = np.asarray(dataset.binned)  # (N, G) uint8
+            if pad:
+                binned = np.pad(binned, ((0, pad), (0, 0)))
+            self.binned = jnp.asarray(binned)
+        # the (G, N) copy is a device-side transpose: uploading it
+        # separately doubled the host->device transfer and the host
+        # ascontiguousarray pass (~seconds at 10M rows)
+        self.binned_t = jnp.transpose(self.binned)
 
         self.meta = FeatureMeta.from_dataset(dataset, slot_stride=nb)
         self.hyper = SplitHyper.from_config(config)
@@ -177,10 +187,10 @@ class DeviceGrower:
              (max(int(64 * scale), 4), 128))
             if ws < self.wave_width and cap < self.num_leaves
         ] + [(self.wave_width, None)]
-        # Pallas wave-histogram kernel for the full-width stage (VMEM
-        # one-hot tiles, see ops/hist_pallas.py).  auto = on for real
-        # TPU; einsum keeps the XLA formulation; interpret runs the
-        # kernel in interpreter mode (CPU tests).
+        # hist_kernel: "auto"/"einsum" use the XLA einsum formulation —
+        # the best measured (both Pallas kernels lost to it, see
+        # ops/hist_pallas.py); "pallas" opts into the VMEM kernel on
+        # hardware, "interpret" runs it in interpreter mode (CPU tests).
         mode = str(getattr(config, "hist_kernel", "auto")
                    or "auto").lower()
         self.pallas_interpret = mode == "interpret"
@@ -233,11 +243,22 @@ class DeviceGrower:
 
         def body(acc, xs):
             b, l, gk = xs
-            oh = jax.nn.one_hot(b, nb, dtype=jnp.bfloat16)       # (CH,G,NB)
             lm = (l[:, None] == pending[None, :]).astype(jnp.bfloat16)
             bmat = (lm[:, :, None] * gk[:, None, :]).reshape(ch, w * k)
-            out = jnp.einsum("cgn,cb->gnb", oh, bmat,
-                             preferred_element_type=jnp.float32)
+            # bin tiling: a one-hot wider than 64 breaks XLA's
+            # operand fusion (max_bin=255 measured 10x the max_bin=63
+            # wave, not the expected 4x) — strips of 64 keep each
+            # einsum in the known-fused regime; out-of-strip bins make
+            # all-zero one-hot rows, so the concat reassembles exactly
+            bi = b.astype(jnp.int32)
+            outs = []
+            for off in range(0, nb, 64):
+                oh = jax.nn.one_hot(bi - off, min(nb, 64),
+                                    dtype=jnp.bfloat16)        # (CH,G,64)
+                outs.append(jnp.einsum("cgn,cb->gnb", oh, bmat,
+                                       preferred_element_type=jnp.float32))
+            out = outs[0] if len(outs) == 1 \
+                else jnp.concatenate(outs, axis=1)
             return acc + out, None
 
         acc0 = jnp.zeros((g, nb, w * k), jnp.float32)

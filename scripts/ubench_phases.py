@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""Phase attribution for the device grower + int8 histogram probe.
+
+Goal (round 5): account for the ~90 ms/tree gap between the production
+while_loop program (468 ms/tree at HIGGS shape) and the sum of the
+measured phases (~377 ms), and measure whether int8 MXU matmuls (2x
+bf16 peak on v5e) can cut the wave-histogram floor.
+
+Protocol: scripts/ubench_hist.py's data-dependent fori_loop timing —
+(T(k) - T(1)) / (k - 1) cancels dispatch floor and RTT.
+
+Usage: python scripts/ubench_phases.py [--rows N] [--cases a,b,...]
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import time
+
+import sys as _sys
+_sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+CHUNK = 32768
+
+
+def run_case(name, body, state0, arrays=(), iters=8, flops=None):
+    def make(k):
+        @jax.jit
+        def run(s, *arrs):
+            s = jax.lax.fori_loop(0, k, lambda i, t: body(t, i, arrs), s)
+            return jax.tree.map(
+                lambda x: jnp.sum(x.astype(jnp.float32)) if x.ndim else x,
+                s)
+        return run
+
+    def timed(run, s0):
+        out = run(s0, *arrays)
+        jax.block_until_ready(jax.tree.map(np.asarray, out))
+        t0 = time.perf_counter()
+        out = run(s0, *arrays)
+        jax.tree.map(np.asarray, out)
+        return time.perf_counter() - t0
+
+    t1 = timed(make(1), state0)
+    tk = timed(make(iters), state0)
+    ms = (tk - t1) / (iters - 1) * 1e3
+    rec = {"case": name, "ms": round(ms, 2),
+           "ms_1": round(t1 * 1e3, 1), "ms_k": round(tk * 1e3, 1)}
+    if flops:
+        rec["tflops"] = round(flops / (ms / 1e3) / 1e12, 1)
+    print(json.dumps(rec), flush=True)
+    return ms
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=10_500_000)
+    ap.add_argument("--groups", type=int, default=28)
+    ap.add_argument("--nb", type=int, default=64)
+    ap.add_argument("--leaves", type=int, default=255)
+    ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--cases", type=str, default="")
+    args = ap.parse_args()
+
+    n = (args.rows + CHUNK - 1) // CHUNK * CHUNK
+    g, nb, L = args.groups, args.nb, args.leaves
+    S = g * nb
+    it = args.iters
+    rng = np.random.default_rng(0)
+    binned_np = rng.integers(0, nb, (n, g), dtype=np.uint8)
+    binned = jnp.asarray(binned_np)
+    binned_t = jnp.asarray(np.ascontiguousarray(binned_np.T))
+    leaf_id = jnp.asarray(rng.integers(0, 128, n, dtype=np.int32))
+    grad = jnp.asarray(rng.standard_normal(n, dtype=np.float32))
+    hess = jnp.asarray(rng.random(n, dtype=np.float32))
+    print(json.dumps({"case": "setup", "rows": n, "device":
+                      str(jax.devices()[0])}), flush=True)
+    want = set(args.cases.split(",")) if args.cases else None
+
+    def on(name):
+        return want is None or name in want
+
+    gh3 = jnp.stack([grad.astype(jnp.bfloat16), hess.astype(jnp.bfloat16),
+                     jnp.ones((n,), jnp.bfloat16)], 1)
+    # int8 probe: gradients quantized to +-127 by a global scale; counts
+    # stay exact (0/1 columns, int32 accumulation)
+    gs = 127.0 / float(np.abs(np.asarray(grad)).max())
+    gh3_i8 = jnp.stack([
+        jnp.clip(jnp.round(grad * gs), -127, 127).astype(jnp.int8),
+        jnp.clip(jnp.round(hess * 127.0), -127, 127).astype(jnp.int8),
+        jnp.ones((n,), jnp.int8)], 1)
+
+    def hist_body(w, dtype, st, i, arrs):
+        binned_a, leaf_a, ghk = arrs
+        acc_sum, pending = st
+        k = ghk.shape[1]
+        n_chunks = n // CHUNK
+        binned_c = binned_a.reshape(n_chunks, CHUNK, g)
+        leaf_c = leaf_a.reshape(n_chunks, CHUNK)
+        gh_c = ghk.reshape(n_chunks, CHUNK, k)
+        acc_t = jnp.int32 if dtype == jnp.int8 else jnp.float32
+
+        def body(acc, xs):
+            b, l, g5 = xs
+            oh = jax.nn.one_hot(b, nb, dtype=dtype)
+            lm = (l[:, None] == pending[None, :]).astype(dtype)
+            if dtype == jnp.int8:
+                bmat = (lm[:, :, None] * g5[:, None, :]).reshape(
+                    CHUNK, w * k)
+            else:
+                bmat = (lm[:, :, None] * g5[:, None, :]).reshape(
+                    CHUNK, w * k)
+            out = jnp.einsum("cgn,cb->gnb", oh, bmat,
+                             preferred_element_type=acc_t)
+            return acc + out, None
+
+        acc0 = jnp.zeros((g, nb, w * k), acc_t)
+        acc, _ = jax.lax.scan(body, acc0, (binned_c, leaf_c, gh_c))
+        s = jnp.sum(acc.astype(jnp.float32))
+        shift = (s * 1e-30).astype(jnp.int32) + 1
+        return acc_sum + s, (pending + shift) % 64
+
+    for name, ghk, w, dt in [
+            ("hist3_bf16_w128", gh3, 128, jnp.bfloat16),
+            ("hist3_int8_w128", gh3_i8, 128, jnp.int8),
+            ("hist3_bf16_w4", gh3, 4, jnp.bfloat16),
+            ("hist3_int8_w4", gh3_i8, 4, jnp.int8),
+            ("hist3_int8_w170", gh3_i8, 170, jnp.int8)]:
+        if not on(name):
+            continue
+        pend0 = jnp.arange(w, dtype=jnp.int32)
+        flops = n * g * nb * w * ghk.shape[1] * 2
+        run_case(name, functools.partial(hist_body, w, dt),
+                 (jnp.float32(0), pend0), arrays=(binned, leaf_id, ghk),
+                 iters=it, flops=flops)
+
+    # ---- find_best over the full leaf table (N-independent) ------------
+    if on("find_best_2w"):
+        from lightgbm_tpu.config import Config
+        from lightgbm_tpu.data.dataset import BinnedDataset
+        from lightgbm_tpu.ops.split import (FeatureMeta, SplitHyper,
+                                            find_best_split_impl)
+        xs = rng.standard_normal((4096, g)).astype(np.float32)
+        cfg = Config({"objective": "binary", "max_bin": nb - 1,
+                      "num_leaves": L})
+        ds = BinnedDataset.construct_from_matrix(xs, cfg)
+        meta = FeatureMeta.from_dataset(ds, slot_stride=nb)
+        hp = SplitHyper.from_config(cfg)
+        find_one = functools.partial(find_best_split_impl, meta=meta,
+                                     hp=hp, has_cat=False)
+        W2 = 256
+        hists = jnp.asarray(
+            rng.random((W2, S, 3), np.float32) * 100.0)
+        fmask = jnp.ones((len(np.asarray(ds.f_group)),), bool)
+
+        def find_body(st, i, arrs):
+            hists_a, = arrs
+            acc, bump = st
+            cons = jnp.asarray([-jnp.inf, jnp.inf], jnp.float32)
+            h = hists_a + bump
+            totals = h[:, :nb, :].sum(1)
+            packed, _ = jax.vmap(
+                lambda hh, t: find_one(hh, t, cons, fmask))(h, totals)
+            s = jnp.sum(packed[:, 0])
+            return acc + s, (s * 1e-30)
+
+        run_case("find_best_2w", find_body,
+                 (jnp.float32(0), jnp.float32(0)), arrays=(hists,),
+                 iters=it)
+
+    # ---- split apply (int16 chain over (W, N)) --------------------------
+    def apply_body(w, st, i, arrs):
+        binned_t_a, leaf_a = arrs
+        leaf, acc = st
+        grp = (jnp.arange(w, dtype=jnp.int32) + acc.astype(jnp.int32)) % g
+        thr = jnp.full((w,), nb // 2, jnp.int16)
+        i16 = lambda a: a.astype(jnp.int16)
+        cols = i16(jnp.take(binned_t_a, grp, axis=0))
+        lsel = jnp.arange(w, dtype=jnp.int32)
+        mask = (leaf[None, :] == lsel[:, None]) & (cols > thr[:, None])
+        upd = jnp.sum(mask * jnp.int32(1), axis=0, dtype=jnp.int32)
+        leaf2 = leaf + upd
+        s = jnp.sum(upd.astype(jnp.float32)) * 1e-30
+        return (leaf2 - upd, acc + s + 1.0)   # restore ids, keep dep
+
+    if on("apply_w128"):
+        run_case("apply_w128", functools.partial(apply_body, 128),
+                 (leaf_id, jnp.float32(0)), arrays=(binned_t, leaf_id),
+                 iters=it)
+
+    # ---- score update (one-hot L einsum) --------------------------------
+    def score_body(st, i, arrs):
+        leaf_a, = arrs
+        score, = st
+        vals = jnp.arange(L, dtype=jnp.float32) * 1e-6 \
+            + score[0] * 1e-30
+        oh = jax.nn.one_hot(leaf_a % L, L, dtype=jnp.bfloat16)
+        vhi = vals.astype(jnp.bfloat16)
+        vlo = (vals - vhi.astype(jnp.float32)).astype(jnp.bfloat16)
+        upd = jnp.einsum("nl,lk->nk", oh, jnp.stack([vhi, vlo], 1),
+                         preferred_element_type=jnp.float32)
+        return (score + upd[:, 0] + upd[:, 1],)
+
+    if on("score_upd"):
+        run_case("score_upd", score_body,
+                 (jnp.zeros((n,), jnp.float32),), arrays=(leaf_id,),
+                 iters=it)
+
+    # ---- gradient compute (binary logloss) ------------------------------
+    def grad_body(st, i, arrs):
+        label_a, = arrs
+        score, = st
+        r = -label_a / (1.0 + jnp.exp(label_a * score))
+        g_ = r
+        h_ = jnp.abs(r) * (1.0 - jnp.abs(r))
+        return (score + (g_ * h_).sum() * 1e-30 + 1e-6,)
+
+    if on("grad_binary"):
+        run_case("grad_binary", grad_body,
+                 (jnp.zeros((n,), jnp.float32),),
+                 arrays=(jnp.asarray(np.where(
+                     rng.random(n) > 0.5, 1.0, -1.0).astype(np.float32)),),
+                 iters=it)
+
+
+if __name__ == "__main__":
+    main()

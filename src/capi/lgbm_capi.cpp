@@ -16,7 +16,7 @@
 #include <Python.h>
 
 #include <cstdint>
-#include <mutex>
+#include <mutex>   /* std::call_once */
 #include <string>
 #include <unordered_map>
 
@@ -24,13 +24,12 @@
 
 namespace {
 
-std::mutex g_err_mutex;
-std::string g_last_error = "everything is fine";
+/* thread-local like the reference's error buffer: the returned pointer
+ * stays valid for the calling thread regardless of other threads'
+ * failures */
+thread_local std::string g_last_error = "everything is fine";
 
-void set_last_error(const std::string& msg) {
-  std::lock_guard<std::mutex> lk(g_err_mutex);
-  g_last_error = msg;
-}
+void set_last_error(const std::string& msg) { g_last_error = msg; }
 
 /* Initialize the interpreter once; release the GIL so every API entry
  * can use PyGILState_Ensure regardless of calling thread. */
@@ -175,7 +174,6 @@ int64_t as_id(const void* handle) {
 }  // namespace
 
 extern "C" const char* LGBM_GetLastError() {
-  std::lock_guard<std::mutex> lk(g_err_mutex);
   return g_last_error.c_str();
 }
 
