@@ -114,6 +114,41 @@ def allgather_mappers(shard_states, gather_fn=None,
     return mappers
 
 
+def jax_process_gather(pair, max_bytes: int = 1 << 22):
+    """The REAL multi-controller gather hook for ``allgather_mappers``:
+    exchanges this process's ``(start, states)`` pair for every
+    process's pair over ``jax.distributed`` (the analog of the
+    reference's ``Network::Allgather`` of serialized BinMappers,
+    dataset_loader.cpp:900-917).
+
+    Serialized mappers are variable-size python objects, so each pair is
+    pickled into a fixed-size length-prefixed uint8 buffer and exchanged
+    with ``multihost_utils.process_allgather`` — the standard JAX idiom
+    for host-blob exchange.  Requires ``jax.distributed.initialize`` to
+    have run; single-controller callers never need this (the default
+    identity hook already sees all shards)."""
+    import pickle
+
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    blob = pickle.dumps(pair)
+    if len(blob) + 8 > max_bytes:
+        raise LightGBMError(
+            f"serialized mapper shard ({len(blob)} bytes) exceeds the "
+            f"{max_bytes}-byte gather buffer; raise max_bytes")
+    buf = np.zeros(max_bytes, np.uint8)
+    buf[:8] = np.frombuffer(len(blob).to_bytes(8, "little"), np.uint8)
+    buf[8:8 + len(blob)] = np.frombuffer(blob, np.uint8)
+    gathered = np.asarray(
+        multihost_utils.process_allgather(jnp.asarray(buf)))
+    out = []
+    for row in gathered.reshape(-1, max_bytes):
+        ln = int.from_bytes(bytes(row[:8]), "little")
+        out.append(pickle.loads(bytes(row[8:8 + ln])))
+    return out
+
+
 def construct_pre_partitioned(row_shards: Sequence[np.ndarray], config,
                               categorical: Sequence[int] = (),
                               sample_per_shard: int = 0):

@@ -1,0 +1,108 @@
+"""Worker process for tests/test_multiprocess.py.
+
+Runs under ``jax.distributed`` as one of N real OS processes (the
+reference analog: one LightGBM machine process over its socket linker,
+``src/network/linkers_socket.cpp:20-100``).  Each process:
+
+1. finds bins for ITS feature block from its LOCAL sample and exchanges
+   serialized mappers through the real ``jax_process_gather`` hook;
+2. runs a data-parallel histogram + best-split step over a GLOBAL mesh
+   spanning both processes' devices (shard_map + psum over ICI/DCN —
+   the actual collective the data-parallel learner issues per wave);
+3. writes its results to OUT so the parent asserts cross-process
+   equality and parity with a single-process reference computation.
+
+Usage: python _mp_worker.py <coordinator> <num_procs> <rank> <outdir>
+"""
+
+import json
+import os
+import sys
+
+rank = int(sys.argv[3])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=sys.argv[1],
+                           num_processes=int(sys.argv[2]),
+                           process_id=rank)
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.data.distributed import (allgather_mappers,
+                                           find_bin_shard,
+                                           jax_process_gather)
+
+nproc = int(sys.argv[2])
+outdir = sys.argv[4]
+assert len(jax.devices()) == 4 * nproc, \
+    f"expected a global device view, got {len(jax.devices())}"
+
+# --- 1. distributed find-bin with the real gather hook -----------------
+rng = np.random.default_rng(100 + rank)
+local_sample = rng.standard_normal((2000, 10)).astype(np.float64)
+cfg = Config({"objective": "binary", "max_bin": 63, "verbosity": -1})
+pair = find_bin_shard(local_sample, rank, nproc, cfg,
+                      total_sample_cnt=2000, num_data=2000 * nproc)
+mappers = allgather_mappers([pair], gather_fn=lambda p: jax_process_gather(
+    p[0]), num_total_features=10)
+mapper_sig = [m.to_state() for m in mappers]
+
+# --- 2. one data-parallel step over the GLOBAL mesh --------------------
+# per-process gradient block (deterministic), global histogram via psum
+# inside shard_map — the per-wave collective of the data-parallel
+# learner — then an identical best-bin decision on every process
+mesh = Mesh(np.asarray(jax.devices()), ("workers",))
+G = 8 * nproc   # rows per device block
+bins_all = np.arange(4 * nproc * G, dtype=np.int32) % 16
+grad_all = np.sin(np.arange(4 * nproc * G, dtype=np.float32))
+
+arr_bins = jax.make_array_from_callback(
+    (4 * nproc * G,), NamedSharding(mesh, P("workers")),
+    lambda idx: bins_all[idx])
+arr_grad = jax.make_array_from_callback(
+    (4 * nproc * G,), NamedSharding(mesh, P("workers")),
+    lambda idx: grad_all[idx])
+
+
+@jax.jit
+def dp_step(b, g):
+    def local(b_, g_):
+        oh = jax.nn.one_hot(b_, 16, dtype=jnp.float32)
+        hist = jnp.einsum("nb,n->b", oh, g_)
+        return jax.lax.psum(hist, "workers")
+
+    hist = shard_map(local, mesh=mesh, in_specs=(P("workers"),
+                                                 P("workers")),
+                     out_specs=P())(b, g)
+    return hist, jnp.argmax(hist)
+
+
+hist, best = dp_step(arr_bins, arr_grad)
+# hist is replicated over the global mesh; read this process's replica
+hist_local = np.asarray(hist.addressable_data(0))
+
+expected = np.zeros(16, np.float32)
+np.add.at(expected, bins_all, grad_all)
+
+out = {
+    "rank": rank,
+    "num_mappers": len(mapper_sig),
+    "mapper_hash": hash(json.dumps(mapper_sig, sort_keys=True)) & 0xFFFFFFFF,
+    "mapper_sig": mapper_sig,
+    "best_bin": int(np.asarray(best.addressable_data(0))),
+    "hist_max_err": float(np.abs(hist_local - expected).max()),
+}
+with open(os.path.join(outdir, f"rank{rank}.json"), "w") as fh:
+    json.dump(out, fh)
+print(f"rank {rank} OK", flush=True)
