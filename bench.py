@@ -441,6 +441,21 @@ def main() -> int:
                     default=os.environ.get("BENCH_SUITE", "all"),
                     help="all = HIGGS headline + MSLR lambdarank "
                          "(both north stars, BASELINE.md)")
+    ap.add_argument("--metrics", default=os.environ.get("BENCH_METRICS",
+                                                        ""),
+                    help="write the telemetry metrics JSON snapshot "
+                         "(docs/Observability.md schema) to this path")
+    ap.add_argument("--trace", default=os.environ.get("BENCH_TRACE", ""),
+                    help="write a Chrome-trace/Perfetto timeline of the "
+                         "run to this path")
+    ap.add_argument("--no-obs", action="store_true",
+                    default=os.environ.get("BENCH_NO_OBS", "").lower()
+                    in ("1", "true", "yes"),
+                    help="disable the telemetry registry entirely (it is "
+                         "on by default so the result JSON carries "
+                         "recompile counts and iteration percentiles; "
+                         "per-dispatch cost is one flag check + a "
+                         "signature hash)")
     args = ap.parse_args()
     if args.quick:
         args.rows = min(args.rows, 1_000_000)
@@ -454,6 +469,15 @@ def main() -> int:
         args.chunk = max(d for d in range(1, cap + 1)
                          if args.iters % d == 0)
 
+    # telemetry: on by default so every BENCH_*.json round captures
+    # recompile counts and p95 iteration time alongside the phase means
+    from lightgbm_tpu import obs
+    if not args.no_obs or args.metrics or args.trace:
+        obs.configure(enabled=True, sync=args.profile)
+    else:
+        # genuinely disable (env vars may have enabled it at import)
+        obs.configure(enabled=False)
+
     if args.suite == "mslr":
         result = run_mslr(args)
     else:
@@ -463,6 +487,13 @@ def main() -> int:
                 result["mslr"] = run_mslr(args)
             except Exception as e:   # noqa: BLE001 — keep the headline
                 result["mslr"] = {"error": str(e)}
+
+    if obs.enabled():
+        result["obs"] = obs.summary()
+        if args.metrics:
+            obs.dump_metrics(args.metrics)
+        if args.trace:
+            obs.dump_trace(args.trace)
     print(json.dumps(result))
     return 0
 

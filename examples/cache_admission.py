@@ -242,7 +242,20 @@ def main() -> int:
     ap.add_argument("--cutoff", type=float, default=0.5)
     ap.add_argument("--sampling", type=int, default=1,
                     choices=(0, 1, 2))
+    ap.add_argument("--metrics", default="",
+                    help="write the telemetry metrics JSON snapshot "
+                         "(docs/Observability.md) — per-window retrain "
+                         "counts, recompiles, iteration percentiles")
+    ap.add_argument("--obs-trace", default="",
+                    help="write a Chrome-trace/Perfetto timeline of the "
+                         "whole windowed session (--trace is taken by "
+                         "the input trace file)")
     args = ap.parse_args()
+
+    from lightgbm_tpu import obs
+    if args.metrics or args.obs_trace:
+        obs.configure(enabled=True, metrics_path=args.metrics or None,
+                      trace_path=args.obs_trace or None)
 
     if args.trace == "synth":
         ids, sizes, costs = synth_trace(args.requests, args.objects)
@@ -257,6 +270,7 @@ def main() -> int:
     windows = []
     n_windows = len(ids) // args.window
     for w in range(n_windows):
+        obs.instant("window_start", cat="harness", window=w)
         lo, hi = w * args.window, (w + 1) * args.window
         wid, wsz, wco = ids[lo:hi], sizes[lo:hi], costs[lo:hi]
 
@@ -300,6 +314,10 @@ def main() -> int:
         / (args.sample / 1e6)
     derive_per_m = float(np.mean([w["derive_s"] for w in steady])) \
         / (args.window / 1e6)
+    obs_summary = None
+    if obs.enabled():
+        obs.flush()
+        obs_summary = obs.summary()
     print(json.dumps({
         "metric": "cache_admission_train_s_per_1M_sampled_rows",
         "value": round(train_per_m, 3), "unit": "s",
@@ -310,6 +328,7 @@ def main() -> int:
         "derive_s_per_1M_requests": round(derive_per_m, 3),
         "ref_derive_s_per_1M": round(94.6 / 20.0, 3),
         "windows": windows,
+        "obs": obs_summary,
     }))
     return 0
 

@@ -10,12 +10,14 @@ on-device tree traversal.  Model text format is the reference's "v2".
 from __future__ import annotations
 
 import math
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..config import Config
 from ..data.dataset import BinnedDataset
 from ..metrics import create_metrics, create_metric
@@ -198,6 +200,14 @@ class GBDT:
     # ------------------------------------------------------------------
     def init_train(self, train_set: BinnedDataset, objective=None):
         cfg = self.config
+        # telemetry: params may enable the obs subsystem; in the windowed
+        # harness this runs once per retrain window, so it must stay
+        # additive (cross-window recompile/memory totals are the point)
+        obs.configure_from_config(cfg)
+        obs.inc("train.init_train")
+        obs.instant("init_train", cat="boost",
+                    rows=int(train_set.num_data),
+                    features=int(train_set.num_features))
         # re-init invalidates the fused-path caches (gargs hold the OLD
         # dataset's label arrays; a stale stall stack would trip the
         # first chunk's lagged check)
@@ -357,9 +367,23 @@ class GBDT:
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
         """One boosting iteration; returns True when training should stop
         (no splittable leaves), mirroring GBDT::TrainOneIter."""
-        if (self._grower is not None and gradients is None
-                and hessians is None):
-            return self._train_one_iter_device()
+        device = (self._grower is not None and gradients is None
+                  and hessians is None)
+        if not obs.enabled():
+            return self._train_one_iter_device() if device \
+                else self._train_one_iter_host(gradients, hessians)
+        # note: without obs sync the device path's span covers dispatch,
+        # not device execution (dispatch is async); enable sync profiling
+        # for honest per-iteration device attribution
+        with obs.span("train.iter", cat="boost", iteration=self.iter,
+                      path="device" if device else "host") as sp:
+            out = self._train_one_iter_device() if device \
+                else self._train_one_iter_host(gradients, hessians)
+            sp.sync_value = self.train_score
+        obs.sample_device_memory()
+        return out
+
+    def _train_one_iter_host(self, gradients=None, hessians=None) -> bool:
         init_scores = [0.0] * self.num_model
         if gradients is None or hessians is None:
             for k in range(self.num_model):
@@ -563,9 +587,12 @@ class GBDT:
                 return False
             bias = self.boost_from_average(0) if not self.models else 0.0
             fused = self._grower.fused_train(chunk)
+            t0 = time.perf_counter() if obs.enabled() else None
             score, (rec_i, rec_f, rec_c, nl, _root, waves) = fused(
                 self._grower.binned, self._grower.binned_t,
                 self.train_score[0], mask, lr, gargs, grad_fn=grad_fn)
+            if t0 is not None:
+                self._obs_chunk(t0, chunk, score)
             self.train_score = self.train_score.at[0].set(score)
             stack = _RecStack(rec_i, rec_f, rec_c, nl)
             for i in range(chunk):
@@ -583,6 +610,23 @@ class GBDT:
                 self._trim_device_stumps()
                 return True
         return False
+
+    def _obs_chunk(self, t0, chunk, score):
+        """Record one fused multi-iteration dispatch: a ``train.chunk``
+        span plus ``chunk`` synthetic ``train.iter`` observations (the
+        chunk mean) so iteration counts/percentiles stay comparable with
+        the per-iteration paths.  Without obs sync this times the
+        dispatch, not device execution."""
+        from ..obs.state import STATE
+        if STATE.sync:
+            jax.block_until_ready(score)
+        dt = time.perf_counter() - t0
+        STATE.registry.observe("train.chunk", dt)
+        STATE.trace.add("train.chunk", cat="boost", t0=t0, dur=dt,
+                        args={"iteration": self.iter, "chunk": chunk})
+        for _ in range(chunk):
+            STATE.registry.observe("train.iter", dt / chunk)
+        obs.sample_device_memory()
 
     def _trim_device_stumps(self):
         """Remove trailing stump iterations (the device path keeps
@@ -603,9 +647,14 @@ class GBDT:
         device path trims those iterations here (not just at the lagged
         stall check) to keep predict()/save consistent with the training
         scores no matter when training stopped."""
-        for i, m in enumerate(self.models):
-            if isinstance(m, _Pending):
-                self.models[i] = m.materialize(self.train_set, self.config)
+        pending = [i for i, m in enumerate(self.models)
+                   if isinstance(m, _Pending)]
+        if pending:
+            with obs.span("flush_pending", cat="boost",
+                          trees=len(pending)):
+                for i in pending:
+                    self.models[i] = self.models[i].materialize(
+                        self.train_set, self.config)
         if self._grower is not None:
             nm = max(self.num_model, 1)
             while (len(self.models) > nm

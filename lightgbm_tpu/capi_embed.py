@@ -12,6 +12,12 @@ Zero-copy discipline: input pointers arrive as read-only memoryviews
 (``np.frombuffer`` wraps them without copying); prediction output is
 written directly into the caller's pre-allocated buffer through a
 writable memoryview.
+
+Telemetry: importing this module initialises :mod:`lightgbm_tpu.obs`,
+which reads ``LGBM_TPU_METRICS`` / ``LGBM_TPU_TRACE`` — so the native
+windowed harness gets per-window retrain spans, recompile counts and
+memory peaks by exporting two env vars, no C++ change.  Each
+``booster_create`` marks a retrain window boundary.
 """
 
 from __future__ import annotations
@@ -19,6 +25,7 @@ from __future__ import annotations
 import numpy as np
 
 from . import c_api as C
+from . import obs
 
 
 def _arr(mv, dtype_const):
@@ -34,12 +41,14 @@ def dataset_from_csr(indptr_mv, indptr_type, indices_mv, data_mv,
                      data_type, nindptr, nelem, num_col, params,
                      ref_handle):
     out = C.Ref()
-    _call(C.LGBM_DatasetCreateFromCSR,
-          _arr(indptr_mv, indptr_type), indptr_type,
-          _arr(indices_mv, C.C_API_DTYPE_INT32),
-          _arr(data_mv, data_type), data_type,
-          int(nindptr), int(nelem), int(num_col), params,
-          ref_handle or None, out)
+    with obs.span("capi.dataset_from_csr", cat="capi",
+                  rows=int(nindptr) - 1):
+        _call(C.LGBM_DatasetCreateFromCSR,
+              _arr(indptr_mv, indptr_type), indptr_type,
+              _arr(indices_mv, C.C_API_DTYPE_INT32),
+              _arr(data_mv, data_type), data_type,
+              int(nindptr), int(nelem), int(num_col), params,
+              ref_handle or None, out)
     return int(out.value)
 
 
@@ -69,7 +78,10 @@ def dataset_free(handle):
 
 def booster_create(train_handle, params):
     out = C.Ref()
-    _call(C.LGBM_BoosterCreate, train_handle, params, out)
+    # each fresh booster is one retrain window in the LRB-style harness
+    obs.inc("capi.retrain_windows")
+    with obs.span("capi.booster_create", cat="capi"):
+        _call(C.LGBM_BoosterCreate, train_handle, params, out)
     return int(out.value)
 
 
@@ -79,7 +91,8 @@ def booster_free(handle):
 
 def booster_update_one_iter(handle):
     fin = C.Ref()
-    _call(C.LGBM_BoosterUpdateOneIter, handle, fin)
+    with obs.span("capi.update_one_iter", cat="capi"):
+        _call(C.LGBM_BoosterUpdateOneIter, handle, fin)
     return int(fin.value)
 
 
@@ -96,12 +109,14 @@ def booster_predict_for_csr(handle, indptr_mv, indptr_type, indices_mv,
                             predict_type, num_iteration, params, out_mv):
     out_len = C.Ref()
     out_arr = np.frombuffer(out_mv, np.float64)
-    _call(C.LGBM_BoosterPredictForCSR, handle,
-          _arr(indptr_mv, indptr_type), indptr_type,
-          _arr(indices_mv, C.C_API_DTYPE_INT32),
-          _arr(data_mv, data_type), data_type,
-          int(nindptr), int(nelem), int(num_col), predict_type,
-          num_iteration, params, out_len, out_arr)
+    with obs.span("capi.predict_for_csr", cat="capi",
+                  rows=int(nindptr) - 1):
+        _call(C.LGBM_BoosterPredictForCSR, handle,
+              _arr(indptr_mv, indptr_type), indptr_type,
+              _arr(indices_mv, C.C_API_DTYPE_INT32),
+              _arr(data_mv, data_type), data_type,
+              int(nindptr), int(nelem), int(num_col), predict_type,
+              num_iteration, params, out_len, out_arr)
     return int(out_len.value)
 
 

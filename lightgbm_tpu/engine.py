@@ -10,11 +10,23 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from . import callback as callback_mod
+from . import obs
 from .basic import Booster, Dataset
 from .config import normalize_params
 from .utils.log import LightGBMError, log_warning
 
 __all__ = ["train", "cv"]
+
+
+def _dedupe_callbacks(callbacks) -> List:
+    """Explicit ordered dedupe of user callbacks (identity/equality based,
+    first occurrence wins) — replaces the old ``set()`` which iterated in
+    hash order."""
+    out: List = []
+    for cb in (callbacks or []):
+        if cb not in out:
+            out.append(cb)
+    return out
 
 
 def train(params, train_set, num_boost_round=100, valid_sets=None,
@@ -62,24 +74,32 @@ def train(params, train_set, num_boost_round=100, valid_sets=None,
                 vs.reference = train_set
             booster.add_valid(vs, valid_names[i])
 
-    cbs = set(callbacks or [])
+    # user callbacks keep their insertion order (a set iterates in hash
+    # order — nondeterministic across runs for same-`order` callbacks);
+    # duplicates are removed explicitly, first occurrence wins
+    cbs = _dedupe_callbacks(callbacks)
     if early_stopping_rounds is not None and early_stopping_rounds > 0:
-        cbs.add(callback_mod.early_stopping(
+        cbs.append(callback_mod.early_stopping(
             early_stopping_rounds,
             verbose=bool(verbose_eval)))
     if verbose_eval is True:
-        cbs.add(callback_mod.print_evaluation())
+        cbs.append(callback_mod.print_evaluation())
     elif isinstance(verbose_eval, int) and verbose_eval is not False:
-        cbs.add(callback_mod.print_evaluation(verbose_eval))
+        cbs.append(callback_mod.print_evaluation(verbose_eval))
     if evals_result is not None:
-        cbs.add(callback_mod.record_evaluation(evals_result))
+        cbs.append(callback_mod.record_evaluation(evals_result))
     if learning_rates is not None:
-        cbs.add(callback_mod.reset_parameter(learning_rate=learning_rates))
+        cbs.append(callback_mod.reset_parameter(learning_rate=learning_rates))
+    if obs.enabled():
+        # telemetry hooks: CallbackEnv-compatible pair timing each
+        # iteration and sampling device memory (docs/Observability.md)
+        cbs.extend(obs.iteration_hooks())
 
     cbs_before = [cb for cb in cbs
                   if getattr(cb, "before_iteration", False)]
     cbs_after = [cb for cb in cbs
                  if not getattr(cb, "before_iteration", False)]
+    # stable sort: equal `order` preserves insertion order
     cbs_before.sort(key=lambda cb: getattr(cb, "order", 0))
     cbs_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
@@ -119,6 +139,12 @@ def train(params, train_set, num_boost_round=100, valid_sets=None,
         booster.best_score[rec[0]][rec[1]] = rec[2]
     if not keep_training_booster:
         booster._train_set = None
+    try:
+        obs.flush()   # write metrics/trace files when paths are configured
+    except OSError as e:
+        # telemetry is best-effort: a bad metrics/trace path must not
+        # destroy a fully trained booster
+        log_warning(f"failed to write telemetry output: {e}")
     return booster
 
 
@@ -245,14 +271,15 @@ def cv(params, train_set, num_boost_round=100, folds=None, nfold=5,
         boosters.append(bst)
 
     results = collections.defaultdict(list)
-    cbs = set(callbacks or [])
+    cbs = _dedupe_callbacks(callbacks)
     if early_stopping_rounds is not None and early_stopping_rounds > 0:
-        cbs.add(callback_mod.early_stopping(early_stopping_rounds,
-                                            verbose=False))
+        cbs.append(callback_mod.early_stopping(early_stopping_rounds,
+                                               verbose=False))
     if verbose_eval is True:
-        cbs.add(callback_mod.print_evaluation(show_stdv=show_stdv))
+        cbs.append(callback_mod.print_evaluation(show_stdv=show_stdv))
     elif isinstance(verbose_eval, int) and verbose_eval not in (False, None):
-        cbs.add(callback_mod.print_evaluation(verbose_eval, show_stdv))
+        cbs.append(callback_mod.print_evaluation(verbose_eval, show_stdv))
+    # stable sort keeps insertion order for equal `order`
     cbs = sorted(cbs, key=lambda cb: getattr(cb, "order", 0))
 
     class _CVBooster:

@@ -1,0 +1,431 @@
+"""Structured telemetry: metrics registry, trace events, recompile and
+device-memory tracking.
+
+One process-global :class:`~.state.ObsState` backs the whole subsystem.
+Everything is **off by default** and every instrumentation site reduces
+to a single flag check when disabled, so the hot path pays nothing.
+
+Enable it three ways (any one suffices):
+
+* config params: ``metrics_enabled=true`` and/or ``trace_path=out.json``
+  (picked up by ``GBDT.init_train``, so ``engine.train``, the sklearn
+  wrapper, the C API and the embedded windowed harness all inherit it);
+* env vars: ``LGBM_TPU_METRICS=<path|1>`` / ``LGBM_TPU_TRACE=<path>``
+  / ``LGBM_TPU_EVENTS=<path.jsonl>`` — files are written at process
+  exit, which is how the ``src/capi`` harness gets per-window retrain
+  telemetry without a code change;
+* programmatically: ``obs.configure(enabled=True, ...)`` (what
+  ``bench.py --metrics/--trace`` does).
+
+The registry subsumes the legacy ``TRAIN_TIMER``: while enabled, every
+``Timer.stop`` also lands in the registry as a ``phase.<tag>`` timing,
+so phase totals/counts/percentiles appear in the metrics snapshot next
+to iteration timings, recompile counts and memory peaks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+from .jit_track import track_jit  # noqa: F401  (re-export)
+from .registry import MetricsRegistry  # noqa: F401  (re-export)
+from .state import STATE
+
+SCHEMA_NAME = "lightgbm-tpu-metrics"
+SCHEMA_VERSION = 1
+
+__all__ = [
+    "enabled", "configure", "configure_from_config", "reset", "registry",
+    "inc", "set_gauge", "max_gauge", "observe", "span", "instant",
+    "counter_sample", "track_jit", "sample_device_memory",
+    "device_memory_stats", "snapshot", "summary", "dump_metrics",
+    "dump_trace", "dump_events_jsonl", "flush", "iteration_hooks",
+]
+
+
+# ---------------------------------------------------------------------------
+# lifecycle
+# ---------------------------------------------------------------------------
+
+def enabled() -> bool:
+    return STATE.enabled
+
+
+def registry() -> MetricsRegistry:
+    return STATE.registry
+
+
+def configure(enabled: Optional[bool] = None,
+              metrics_path: Optional[str] = None,
+              trace_path: Optional[str] = None,
+              events_path: Optional[str] = None,
+              sync: Optional[bool] = None) -> None:
+    """Update the global observability state.
+
+    Additive: ``None`` leaves a setting untouched, and enabling twice
+    keeps the accumulated registry/trace (windowed retraining wants
+    cross-window totals).  Use :func:`reset` for a clean slate.
+    """
+    if metrics_path:
+        STATE.metrics_path = metrics_path
+    if trace_path:
+        STATE.trace_path = trace_path
+    if events_path:
+        STATE.events_path = events_path
+    if sync is not None:
+        STATE.sync = bool(sync)
+    if enabled is not None:
+        was = STATE.enabled
+        STATE.enabled = bool(enabled)
+        if STATE.enabled and not was:
+            _install_timer_sink()
+        elif was and not STATE.enabled:
+            _remove_timer_sink()
+    if STATE.enabled and (STATE.metrics_path or STATE.trace_path
+                          or STATE.events_path):
+        _register_atexit()
+
+
+def configure_from_config(cfg) -> None:
+    """Pick up ``metrics_enabled`` / ``trace_path`` from a Config.
+
+    Called on every ``GBDT.init_train`` — i.e. once per booster, which
+    in the windowed harness means once per retrain window — so it must
+    be cheap and must never *disable* telemetry another component turned
+    on (first window enables, later windows accumulate).
+    """
+    want = bool(getattr(cfg, "metrics_enabled", False))
+    trace_path = str(getattr(cfg, "trace_path", "") or "")
+    metrics_path = str(getattr(cfg, "metrics_path", "") or "")
+    if not (want or trace_path or metrics_path):
+        return
+    configure(enabled=True, metrics_path=metrics_path or None,
+              trace_path=trace_path or None)
+
+
+def reset() -> None:
+    """Clear all accumulated metrics and events (keeps enabled/paths)."""
+    STATE.registry.reset()
+    STATE.trace.reset()
+    STATE._mem_unavailable = False
+    STATE._trace_flushed = None
+
+
+# ---------------------------------------------------------------------------
+# recording primitives
+# ---------------------------------------------------------------------------
+
+def inc(name: str, value: int = 1) -> None:
+    if STATE.enabled:
+        STATE.registry.inc(name, value)
+
+
+def set_gauge(name: str, value: float) -> None:
+    if STATE.enabled:
+        STATE.registry.set_gauge(name, value)
+
+
+def max_gauge(name: str, value: float) -> None:
+    if STATE.enabled:
+        STATE.registry.max_gauge(name, value)
+
+
+def observe(name: str, seconds: float) -> None:
+    if STATE.enabled:
+        STATE.registry.observe(name, seconds)
+
+
+class _NullSpan:
+    """Shared no-op context manager: the disabled fast path allocates
+    nothing.  ``sync_value`` accepts and discards writes, so the
+    documented ``sp.sync_value = arr`` pattern is safe whether or not
+    telemetry is on — without the shared singleton retaining a
+    reference to a (possibly multi-MB) device array."""
+
+    __slots__ = ()
+
+    @property
+    def sync_value(self):
+        return None
+
+    @sync_value.setter
+    def sync_value(self, value):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **args):
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "cat", "args", "t0", "sync_value")
+
+    def __init__(self, name, cat, args):
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self.sync_value = None
+        self.t0 = time.perf_counter()
+
+    def set(self, **args):
+        """Attach attributes after the span opened."""
+        self.args.update(args)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if STATE.sync and self.sync_value is not None:
+            import jax
+            jax.block_until_ready(self.sync_value)
+        dur = time.perf_counter() - self.t0
+        STATE.registry.observe(self.name, dur)
+        STATE.trace.add(self.name, cat=self.cat, t0=self.t0, dur=dur,
+                        args=self.args or None)
+        return False
+
+
+def span(name: str, cat: str = "train", **args):
+    """Timed span: ``with obs.span("grow_tree", iter=k): ...``.
+
+    Records a timing observation under ``name`` and a trace event.  Set
+    ``span.sync_value = device_array`` inside the block to make the exit
+    block on the device value when sync profiling is on (honest device
+    attribution; guarded so production runs never block).
+    """
+    if not STATE.enabled:
+        return _NULL_SPAN
+    return _Span(name, cat, dict(args) if args else {})
+
+
+def instant(name: str, cat: str = "train", **args) -> None:
+    """Zero-duration marker event."""
+    if STATE.enabled:
+        STATE.trace.add(name, cat=cat, kind="instant", args=args or None)
+
+
+def counter_sample(name: str, cat: str = "mem", **values) -> None:
+    """Chrome-trace counter track sample (renders as a stacked area)."""
+    if STATE.enabled:
+        STATE.trace.add(name, cat=cat, kind="counter", args=values)
+
+
+# ---------------------------------------------------------------------------
+# device memory
+# ---------------------------------------------------------------------------
+
+def device_memory_stats() -> Optional[Dict[str, int]]:
+    """Raw ``Device.memory_stats()`` of the first device, or None when
+    the backend does not expose it (CPU does not)."""
+    if STATE._mem_unavailable:
+        return None
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        stats = None
+    if not stats:
+        STATE._mem_unavailable = True
+        return None
+    return stats
+
+
+def sample_device_memory() -> None:
+    """Record bytes-in-use / peak gauges and a trace counter sample."""
+    if not STATE.enabled or STATE._mem_unavailable:
+        return
+    stats = device_memory_stats()
+    if stats is None:
+        return
+    in_use = stats.get("bytes_in_use")
+    peak = stats.get("peak_bytes_in_use")
+    if in_use is not None:
+        STATE.registry.set_gauge("device.bytes_in_use", int(in_use))
+        counter_sample("device_memory", bytes_in_use=int(in_use))
+    if peak is not None:
+        STATE.registry.max_gauge("device.peak_bytes_in_use", int(peak))
+
+
+# ---------------------------------------------------------------------------
+# snapshot / export
+# ---------------------------------------------------------------------------
+
+def snapshot() -> Dict:
+    """Full schema-versioned metrics document (see docs/Observability.md)."""
+    doc = {
+        "schema": SCHEMA_NAME,
+        "schema_version": SCHEMA_VERSION,
+        "created_unix": round(STATE.registry.created_unix, 3),
+        "snapshot_unix": round(time.time(), 3),
+        "enabled": STATE.enabled,
+    }
+    doc.update(STATE.registry.snapshot())
+    mem = device_memory_stats()
+    doc["device_memory"] = (
+        {"bytes_in_use": int(mem.get("bytes_in_use", 0)),
+         "peak_bytes_in_use": int(mem.get("peak_bytes_in_use", 0))}
+        if mem else None)
+    doc["events"] = {"recorded": len(STATE.trace),
+                     "dropped": STATE.trace.dropped}
+    return doc
+
+
+def summary() -> Dict:
+    """Compact digest for embedding in bench JSON lines: recompile
+    counts per jitted fn, iteration p95, peak device memory."""
+    snap = STATE.registry.snapshot()
+    iter_stat = snap["timings"].get("train.iter")
+    compile_total = sum(v["compiles"] for v in snap["jit"].values())
+    out = {
+        "jit_compiles": {k: v["compiles"] for k, v in snap["jit"].items()},
+        "jit_compiles_total": compile_total,
+        "iter_p95_ms": round(iter_stat["p95_s"] * 1e3, 2)
+        if iter_stat else None,
+        "iter_p50_ms": round(iter_stat["p50_s"] * 1e3, 2)
+        if iter_stat else None,
+        "peak_device_bytes": STATE.registry.gauge(
+            "device.peak_bytes_in_use"),
+        "events_recorded": len(STATE.trace),
+    }
+    return out
+
+
+def dump_metrics(path: Optional[str] = None) -> Optional[str]:
+    path = path or STATE.metrics_path
+    if not path:
+        return None
+    with open(path, "w") as fh:
+        json.dump(snapshot(), fh, indent=1)
+    return path
+
+
+def dump_trace(path: Optional[str] = None) -> Optional[str]:
+    path = path or STATE.trace_path
+    if not path:
+        return None
+    # the buffer is cumulative and each write serializes all of it, so a
+    # per-window flush loop skips writes when nothing new was recorded
+    key = (path, len(STATE.trace), STATE.trace.dropped)
+    if STATE._trace_flushed == key and os.path.exists(path):
+        return path
+    STATE.trace.to_chrome(path)
+    STATE._trace_flushed = key
+    return path
+
+
+def dump_events_jsonl(path: Optional[str] = None) -> Optional[str]:
+    path = path or STATE.events_path
+    if not path:
+        return None
+    STATE.trace.to_jsonl(path)
+    return path
+
+
+def flush() -> None:
+    """Write every configured output file (idempotent; cheap when no
+    paths are configured)."""
+    if not STATE.enabled:
+        return
+    dump_metrics()
+    dump_trace()
+    dump_events_jsonl()
+
+
+def _register_atexit() -> None:
+    if STATE._atexit_registered:
+        return
+    import atexit
+    atexit.register(flush)
+    STATE._atexit_registered = True
+
+
+# ---------------------------------------------------------------------------
+# TRAIN_TIMER bridge
+# ---------------------------------------------------------------------------
+
+def _timer_sink(tag: str, seconds: float) -> None:
+    STATE.registry.observe(f"phase.{tag}", seconds)
+
+
+def _install_timer_sink() -> None:
+    from ..utils import log
+    log.set_timer_sink(_timer_sink)
+
+
+def _remove_timer_sink() -> None:
+    from ..utils import log
+    log.set_timer_sink(None)
+
+
+# ---------------------------------------------------------------------------
+# engine callback hook (CallbackEnv-compatible)
+# ---------------------------------------------------------------------------
+
+def iteration_hooks() -> Tuple:
+    """(before, after) callbacks for ``engine.train``'s callback list.
+
+    Both take the standard :class:`~lightgbm_tpu.callback.CallbackEnv`.
+    The pair times each boosting iteration end to end (update + eval +
+    other callbacks), samples device memory, and emits eval results as
+    instant events, so a plain ``train(params, ds)`` call with
+    ``metrics_enabled`` produces a full timeline with no user code.
+    """
+    state = {}
+
+    def _before(env):
+        if STATE.enabled:
+            state["t0"] = time.perf_counter()
+    _before.before_iteration = True
+    _before.order = -1000
+
+    def _after(env):
+        t0 = state.pop("t0", None)
+        if t0 is None or not STATE.enabled:
+            return
+        dur = time.perf_counter() - t0
+        STATE.registry.observe("engine.iter", dur)
+        STATE.trace.add("engine_iter", cat="engine", t0=t0, dur=dur,
+                        args={"iteration": env.iteration})
+        for rec in (env.evaluation_result_list or []):
+            instant(f"eval:{rec[0]}:{rec[1]}", cat="eval",
+                    iteration=env.iteration, value=float(rec[2]))
+        sample_device_memory()
+    _after.order = 1000
+
+    return _before, _after
+
+
+# ---------------------------------------------------------------------------
+# env-var activation (no code change needed in embedding hosts)
+# ---------------------------------------------------------------------------
+
+def _configure_from_env() -> None:
+    metrics = os.environ.get("LGBM_TPU_METRICS", "")
+    trace = os.environ.get("LGBM_TPU_TRACE", "")
+    events = os.environ.get("LGBM_TPU_EVENTS", "")
+    if metrics.lower() in ("0", "false", "no"):
+        metrics = ""
+    if not (metrics or trace or events):
+        return
+    configure(
+        enabled=True,
+        metrics_path=metrics if metrics.lower() not in ("1", "true", "yes")
+        else None,
+        trace_path=trace or None,
+        events_path=events or None,
+        sync=os.environ.get("LGBM_TPU_OBS_SYNC", "") in ("1", "true"),
+    )
+
+
+_configure_from_env()

@@ -1,0 +1,104 @@
+"""JIT recompile tracking: count and attribute compiles per shape signature.
+
+In the retrain-every-window pattern (PAPER.md's LRB harness) recompiles
+are the silent killer: every fresh ``DeviceGrower`` owns fresh
+``jax.jit`` objects, so a window whose padded shape differs — or merely
+a new grower instance without a warm persistent XLA cache — pays a full
+trace+compile that the wall-clock numbers otherwise attribute to
+"training".  ``track_jit`` wraps a jitted callable and detects the
+first call per abstract signature (shapes/dtypes of array leaves,
+qualnames for callables, ``repr`` for the rest): that call is the one
+that traces and compiles, so its duration is recorded as a
+``jit_compile:<name>`` span and counted per signature in the registry.
+
+When observability is disabled the wrapper is a single flag check plus
+one indirect call — no signature computation, no allocation.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Tuple
+
+from .state import STATE
+
+
+def _leaf_sig(leaf) -> str:
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{dtype}[{','.join(str(d) for d in shape)}]"
+    if callable(leaf):
+        return getattr(leaf, "__qualname__", None) \
+            or getattr(leaf, "__name__", "<callable>")
+    return repr(leaf)
+
+
+def signature_of(args, kwargs, static_info: Tuple = ()) -> str:
+    import jax
+    leaves = jax.tree_util.tree_leaves((args, kwargs))
+    parts = [str(v) for v in static_info]
+    parts.extend(_leaf_sig(l) for l in leaves)
+    return "(" + ", ".join(parts) + ")"
+
+
+class TrackedJit:
+    """Callable wrapper around a jitted function.
+
+    Each instance keeps its own seen-signature set because each
+    underlying ``jax.jit`` object owns its own compile cache: a new
+    instance recompiles even signatures an older instance already
+    compiled, and that per-instance cost is precisely what windowed
+    retraining needs surfaced.  Counts accumulate into the shared
+    registry under ``name``, so cross-window totals survive grower
+    churn.
+    """
+
+    __slots__ = ("name", "fn", "static_info", "_seen")
+
+    def __init__(self, name, fn, static_info=()):
+        self.name = name
+        self.fn = fn
+        self.static_info = tuple(static_info)
+        self._seen = set()
+
+    def _cache_size(self) -> int:
+        try:
+            return self.fn._cache_size()
+        except Exception:
+            return -1
+
+    def __call__(self, *args, **kwargs):
+        st = STATE
+        if not st.enabled:
+            return self.fn(*args, **kwargs)
+        sig = signature_of(args, kwargs, self.static_info)
+        if sig in self._seen:
+            return self.fn(*args, **kwargs)
+        # first tracked call for this signature on this instance: it
+        # traces + compiles synchronously (dispatch stays async), so its
+        # wall time is the compile cost.  The jit cache size confirms a
+        # trace really happened — a cache warmed before tracking was
+        # enabled (e.g. a disabled warm-up run on the same module-level
+        # jit) must not count as a compile.
+        self._seen.add(sig)
+        before = self._cache_size()
+        t0 = time.perf_counter()
+        out = self.fn(*args, **kwargs)
+        dur = time.perf_counter() - t0
+        if before < 0 or self._cache_size() > before:
+            st.registry.record_compile(self.name, sig)
+            st.registry.inc("jit.compiles_total")
+            st.registry.observe(f"jit_compile.{self.name}", dur)
+            st.trace.add(f"jit_compile:{self.name}", cat="jit", t0=t0,
+                         dur=dur, args={"signature": sig})
+        return out
+
+    # pass through jit-object attributes (lower, clear_cache, ...)
+    def __getattr__(self, item):
+        return getattr(self.fn, item)
+
+
+def track_jit(name: str, fn, static_info: Tuple = ()) -> TrackedJit:
+    """Wrap ``fn`` (typically a ``jax.jit`` result) with compile tracking."""
+    return TrackedJit(name, fn, static_info)

@@ -1,0 +1,44 @@
+"""Process-global observability state.
+
+One module-level singleton keeps the enabled flag, the registry and the
+trace buffer, so every instrumentation site shares the same fast-path
+check: ``if not STATE.enabled: return``.  Kept in its own module (not
+``obs/__init__``) so instrumented modules can import it without pulling
+the exporters, and so there is exactly one import direction:
+``jit_track``/``hooks``/``__init__`` -> ``state`` -> ``registry``/``events``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .events import TraceBuffer
+from .registry import MetricsRegistry
+
+
+class ObsState:
+    __slots__ = ("enabled", "sync", "registry", "trace",
+                 "metrics_path", "trace_path", "events_path",
+                 "_atexit_registered", "_mem_unavailable",
+                 "_trace_flushed")
+
+    def __init__(self):
+        self.enabled = False
+        # when True, iteration instrumentation blocks on the device value
+        # before stopping the clock (honest attribution; serialises the
+        # pipeline — leave off for production runs)
+        self.sync = False
+        self.registry = MetricsRegistry()
+        self.trace = TraceBuffer()
+        self.metrics_path: Optional[str] = None
+        self.trace_path: Optional[str] = None
+        self.events_path: Optional[str] = None
+        self._atexit_registered = False
+        self._mem_unavailable = False
+        # (path, event_count, dropped) of the last trace write, so
+        # repeated flushes (one per train() in a windowed loop) skip
+        # re-serializing an unchanged buffer
+        self._trace_flushed = None
+
+
+STATE = ObsState()

@@ -51,6 +51,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from .split import (F_DEFAULT_LEFT, F_FEATURE, F_GAIN, F_IS_CAT, F_LEFT_C,
                     F_LEFT_G, F_LEFT_H, F_LEFT_OUT, F_RIGHT_C, F_RIGHT_G,
                     F_RIGHT_H, F_RIGHT_OUT, F_THRESHOLD, FeatureMeta,
@@ -200,10 +201,16 @@ class DeviceGrower:
         # auto stays on the einsum until the kernel beats it
         self.use_pallas = mode in ("pallas", "interpret")
         self.lr = float(config.learning_rate)
-        self._grow = jax.jit(functools.partial(self._grow_impl,
-                                               with_mask=False))
-        self._grow_masked = jax.jit(functools.partial(self._grow_impl,
-                                                      with_mask=True))
+        # recompile tracking: every fresh DeviceGrower owns fresh jit
+        # caches, so in the retrain-every-window pattern each window
+        # recompiles these — obs.track_jit counts and attributes that
+        # per shape signature (near-free when obs is disabled)
+        self._grow = obs.track_jit(
+            "grow", jax.jit(functools.partial(self._grow_impl,
+                                              with_mask=False)))
+        self._grow_masked = obs.track_jit(
+            "grow_masked", jax.jit(functools.partial(self._grow_impl,
+                                                     with_mask=True)))
         self._fused = {}   # scan length -> jitted multi-iteration program
 
     # ------------------------------------------------------------------
@@ -617,6 +624,7 @@ class DeviceGrower:
         f32 0/1 in-bag indicator (bagging / GOSS)."""
         if lr is None:
             lr = self.lr
+        obs.inc("grow.dispatches")
         if row_mask is None:
             return self._grow(self.binned, self.binned_t, score, grad,
                               hess, feature_mask,
@@ -670,8 +678,9 @@ class DeviceGrower:
 
                 return jax.lax.scan(body, score, None, length=length)
 
-            self._fused[length] = jax.jit(run,
-                                          static_argnames=("grad_fn",))
+            self._fused[length] = obs.track_jit(
+                "fused_train", jax.jit(run, static_argnames=("grad_fn",)),
+                static_info=(f"len={length}",))
         return self._fused[length]
 
     # ------------------------------------------------------------------
@@ -783,6 +792,8 @@ class DeviceGrower:
         floor = out.pop("null_dispatch")
         out = {k: round(max(v - floor, 0.0), 2) for k, v in out.items()}
         out["dispatch_floor"] = floor
+        for name, ms in out.items():
+            obs.set_gauge(f"profile.{name}_ms", ms)
         return out
 
 

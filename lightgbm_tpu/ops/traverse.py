@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs as _obs
 from ..tree.tree import K_CATEGORICAL_MASK, K_DEFAULT_LEFT_MASK, Tree
 
 
@@ -133,6 +134,11 @@ def add_tree_score(score, binned, t: DeviceTree, multiplier):
     """score += multiplier * leaf_value[traverse(binned)]."""
     leaf = traverse(binned, t)
     return score + multiplier * t.leaf_value[leaf]
+
+
+# recompile tracking for the device predict/eval path (a new row-count
+# or leaf-count shape recompiles the traversal program)
+add_tree_score = _obs.track_jit("add_tree_score", add_tree_score)
 
 
 @jax.jit

@@ -303,6 +303,22 @@ PARAM_SCHEMA: Sequence[Param] = (
     _p("convert_model", str, "gbdt_prediction.cpp",
        ("convert_model_file",),
        desc="output of convert_model task", section="io"),
+    _p("metrics_enabled", bool, False, ("telemetry", "obs_enabled"),
+       desc="enable the structured telemetry subsystem (lightgbm_tpu.obs): "
+            "metrics registry (per-phase/iteration timing histograms with "
+            "p50/p95/max), JIT recompile tracking per shape signature, and "
+            "device memory peaks; near-zero overhead when false. "
+            "Independent of `verbosity` (which only gates stderr logging). "
+            "Env override: LGBM_TPU_METRICS=<path|1>. See "
+            "docs/Observability.md", section="io"),
+    _p("metrics_path", str, "", ("metrics_file",),
+       desc="write the telemetry metrics JSON snapshot to this path at the "
+            "end of train() (implies metrics_enabled)", section="io"),
+    _p("trace_path", str, "", ("trace_file",),
+       desc="write a Chrome-trace / Perfetto timeline of the run to this "
+            "path at the end of train() (implies metrics_enabled). Open at "
+            "https://ui.perfetto.dev. Env override: LGBM_TPU_TRACE=<path>",
+       section="io"),
 
     # -- objective --------------------------------------------------------
     _p("num_class", int, 1, ("num_classes",), check="> 0",

@@ -45,6 +45,7 @@ from ..ops.split import (F_DEFAULT_LEFT, F_FEATURE, F_GAIN, F_IS_CAT,
                          F_LEFT_C, F_LEFT_G, F_LEFT_H, F_LEFT_OUT,
                          F_RIGHT_C, F_RIGHT_G, F_RIGHT_H, F_RIGHT_OUT,
                          F_THRESHOLD, SplitContext)
+from .. import obs
 from ..utils.log import TRAIN_TIMER, log_debug, log_warning
 from .tree import Tree, categorical_bitsets
 
@@ -90,6 +91,14 @@ def _hist_totals(hist):
     """Leaf totals from any single group's slots (every row lands in exactly
     one slot per group)."""
     return hist[0].sum(axis=0)
+
+
+# recompile tracking for the host-learner's hot jits: the padded window
+# sizes (`m`) bucket the shapes, so the number of distinct signatures —
+# and therefore compiles — is observable per training run / per window
+_window_histogram = obs.track_jit("window_histogram", _window_histogram)
+_window_partition = obs.track_jit("window_partition", _window_partition)
+_hist_totals = obs.track_jit("hist_totals", _hist_totals)
 
 
 class _LeafInfo:

@@ -9,6 +9,7 @@ controlled globally via :func:`set_verbosity` (config param ``verbosity``:
 from __future__ import annotations
 
 import sys
+import threading
 import time
 from typing import Callable, Optional
 
@@ -63,12 +64,29 @@ def log_fatal(msg: str) -> None:
     raise LightGBMError(msg)
 
 
+#: optional observer called as ``sink(tag, seconds)`` on every Timer.stop;
+#: the obs subsystem installs one so phase timings also land in its
+#: metrics registry (``phase.<tag>`` timing histograms)
+_TIMER_SINK: Optional[Callable[[str, float], None]] = None
+
+
+def set_timer_sink(sink: Optional[Callable[[str, float], None]]) -> None:
+    global _TIMER_SINK
+    _TIMER_SINK = sink
+
+
 class Timer:
     """Accumulating per-phase wall-clock timer.
 
     First-class version of the reference's compile-time TIMETAG counters
     (``serial_tree_learner.cpp:14-41``): ``timer.start("hist")`` /
-    ``timer.stop("hist")`` accumulate, ``timer.report()`` pretty-prints.
+    ``timer.stop("hist")`` accumulate, ``timer.report()`` pretty-prints
+    totals with call counts and per-call means.
+
+    Thread-safe: the process-global ``TRAIN_TIMER`` is reachable from
+    callbacks and the C-API embed path, which may run on other threads.
+    Concurrent ``start`` of the *same* tag keeps the latest t0 (the
+    earlier start is lost — per-tag nesting is not a supported pattern).
 
     With ``sync=True`` the :meth:`stop_sync` variant blocks on the device
     value before stopping the clock, so phase times attribute device work to
@@ -82,15 +100,23 @@ class Timer:
         self.counts = {}
         self._t0 = {}
         self.sync = False
+        self._lock = threading.Lock()
 
     def start(self, tag: str) -> None:
-        self._t0[tag] = time.perf_counter()
+        with self._lock:
+            self._t0[tag] = time.perf_counter()
 
     def stop(self, tag: str) -> None:
-        t0 = self._t0.pop(tag, None)
-        if t0 is not None:
-            self.acc[tag] = self.acc.get(tag, 0.0) + time.perf_counter() - t0
+        with self._lock:
+            t0 = self._t0.pop(tag, None)
+            if t0 is None:
+                return
+            dt = time.perf_counter() - t0
+            self.acc[tag] = self.acc.get(tag, 0.0) + dt
             self.counts[tag] = self.counts.get(tag, 0) + 1
+        sink = _TIMER_SINK   # snapshot: a concurrent unset must not race
+        if sink is not None:
+            sink(tag, dt)
 
     def stop_sync(self, tag: str, value=None):
         """Stop after blocking on ``value`` when ``sync`` profiling is on."""
@@ -101,12 +127,25 @@ class Timer:
         return value
 
     def report(self) -> str:
-        return ", ".join(f"{k}={v:.3f}s" for k, v in sorted(self.acc.items()))
+        """``hist=1.200s/240 (5.0ms), fetch=0.010s`` — total, call count
+        and per-call mean (count omitted for single-call tags)."""
+        with self._lock:
+            items = sorted(self.acc.items())
+            counts = dict(self.counts)
+        parts = []
+        for k, v in items:
+            c = counts.get(k, 0)
+            if c > 1:
+                parts.append(f"{k}={v:.3f}s/{c} ({v / c * 1e3:.1f}ms)")
+            else:
+                parts.append(f"{k}={v:.3f}s")
+        return ", ".join(parts)
 
     def reset(self) -> None:
-        self.acc.clear()
-        self.counts.clear()
-        self._t0.clear()
+        with self._lock:
+            self.acc.clear()
+            self.counts.clear()
+            self._t0.clear()
 
 
 #: process-global training-phase timer (wired through the tree learner and

@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Schema-check a telemetry metrics JSON (docs/Observability.md).
+
+Usage: ``python scripts/validate_metrics.py metrics.json``
+Exit 0 when the document is schema-valid, 1 with one error per line
+otherwise.  Also importable: ``validate(doc) -> list[str]`` (empty ==
+valid).  ``tests/test_obs.py`` runs this against a live 2-iteration
+``bench.py --metrics`` run so tier-1 exercises the enabled path end to
+end.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List
+
+SCHEMA_NAME = "lightgbm-tpu-metrics"
+SCHEMA_VERSION = 1
+
+_TIMING_KEYS = ("count", "total_s", "mean_s", "p50_s", "p95_s", "max_s")
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def validate(doc: Dict) -> List[str]:
+    errors: List[str] = []
+
+    def err(msg):
+        errors.append(msg)
+
+    if not isinstance(doc, dict):
+        return ["document is not a JSON object"]
+    if doc.get("schema") != SCHEMA_NAME:
+        err(f"schema != {SCHEMA_NAME!r}: {doc.get('schema')!r}")
+    if doc.get("schema_version") != SCHEMA_VERSION:
+        err(f"schema_version != {SCHEMA_VERSION}: "
+            f"{doc.get('schema_version')!r}")
+    for key in ("created_unix", "snapshot_unix"):
+        if not _num(doc.get(key)):
+            err(f"{key} missing or not a number")
+    if not isinstance(doc.get("enabled"), bool):
+        err("enabled missing or not a bool")
+
+    counters = doc.get("counters")
+    if not isinstance(counters, dict):
+        err("counters missing or not an object")
+    else:
+        for k, v in counters.items():
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                err(f"counter {k!r} is not a non-negative int: {v!r}")
+
+    gauges = doc.get("gauges")
+    if not isinstance(gauges, dict):
+        err("gauges missing or not an object")
+    else:
+        for k, v in gauges.items():
+            if not _num(v):
+                err(f"gauge {k!r} is not a number: {v!r}")
+
+    timings = doc.get("timings")
+    if not isinstance(timings, dict):
+        err("timings missing or not an object")
+    else:
+        for name, stat in timings.items():
+            if not isinstance(stat, dict):
+                err(f"timing {name!r} is not an object")
+                continue
+            for k in _TIMING_KEYS:
+                if k not in stat:
+                    err(f"timing {name!r} missing {k!r}")
+                elif not _num(stat[k]):
+                    err(f"timing {name!r}.{k} is not a number")
+            if all(_num(stat.get(k)) for k in _TIMING_KEYS):
+                if stat["count"] < 1:
+                    err(f"timing {name!r} has count < 1")
+                if stat["p50_s"] > stat["p95_s"] + 1e-9:
+                    err(f"timing {name!r}: p50 > p95")
+                if stat["p95_s"] > stat["max_s"] + 1e-9:
+                    err(f"timing {name!r}: p95 > max")
+                if stat["total_s"] + 1e-9 < stat["max_s"]:
+                    err(f"timing {name!r}: total < max")
+
+    jit = doc.get("jit")
+    if not isinstance(jit, dict):
+        err("jit missing or not an object")
+    else:
+        for name, ent in jit.items():
+            if not isinstance(ent, dict):
+                err(f"jit {name!r} is not an object")
+                continue
+            comp = ent.get("compiles")
+            sigs = ent.get("signatures")
+            if not isinstance(comp, int) or comp < 1:
+                err(f"jit {name!r}.compiles is not a positive int")
+            if not isinstance(sigs, dict) or not sigs:
+                err(f"jit {name!r}.signatures missing or empty")
+            elif isinstance(comp, int) and sum(sigs.values()) != comp:
+                err(f"jit {name!r}: signature counts {sum(sigs.values())} "
+                    f"!= compiles {comp}")
+
+    mem = doc.get("device_memory", "MISSING")
+    if mem == "MISSING":
+        err("device_memory key missing (null is fine)")
+    elif mem is not None:
+        if not isinstance(mem, dict):
+            err("device_memory is neither null nor an object")
+        else:
+            for k in ("bytes_in_use", "peak_bytes_in_use"):
+                v = mem.get(k)
+                if not isinstance(v, int) or v < 0:
+                    err(f"device_memory.{k} is not a non-negative int")
+
+    events = doc.get("events")
+    if not isinstance(events, dict):
+        err("events missing or not an object")
+    else:
+        for k in ("recorded", "dropped"):
+            v = events.get(k)
+            if not isinstance(v, int) or v < 0:
+                err(f"events.{k} is not a non-negative int")
+
+    return errors
+
+
+def validate_training_run(doc: Dict) -> List[str]:
+    """Beyond schema shape: assertions a real (enabled) training run
+    must satisfy — per-phase/iteration timings present, at least one
+    tracked jit compile recorded."""
+    errors = validate(doc)
+    if errors:
+        return errors
+    if not doc["enabled"]:
+        errors.append("run was not collected with telemetry enabled")
+    timings = doc["timings"]
+    if "train.iter" not in timings:
+        errors.append("no train.iter timing (no boosting iteration ran?)")
+    if not doc["jit"]:
+        errors.append("no tracked jit compiles recorded")
+    return errors
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print(__doc__, file=sys.stderr)
+        return 2
+    with open(argv[0]) as fh:
+        doc = json.load(fh)
+    errors = validate_training_run(doc)
+    if errors:
+        for e in errors:
+            print(f"INVALID: {e}", file=sys.stderr)
+        return 1
+    n_tim = len(doc["timings"])
+    n_jit = sum(v["compiles"] for v in doc["jit"].values())
+    print(f"OK: {argv[0]} schema-valid ({n_tim} timing series, "
+          f"{n_jit} jit compiles, {doc['events']['recorded']} events)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

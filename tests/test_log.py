@@ -1,0 +1,163 @@
+"""utils/log.py coverage: verbosity thresholds, callback redirection,
+Timer semantics (stop-without-start, stop_sync blocking, report format,
+thread safety)."""
+
+import threading
+
+import pytest
+
+from lightgbm_tpu.utils import log
+from lightgbm_tpu.utils.log import (LightGBMError, Timer, get_verbosity,
+                                    log_debug, log_fatal, log_info,
+                                    log_warning, register_log_callback,
+                                    set_verbosity)
+
+
+@pytest.fixture(autouse=True)
+def _restore_log_state():
+    old_v = get_verbosity()
+    yield
+    set_verbosity(old_v)
+    register_log_callback(None)
+    log.set_timer_sink(None)
+
+
+def _capture():
+    lines = []
+    register_log_callback(lines.append)
+    return lines
+
+
+class TestVerbosity:
+    def test_thresholds(self):
+        for level, expect in [(-1, set()), (0, {"W"}), (1, {"W", "I"}),
+                              (2, {"W", "I", "D"})]:
+            lines = _capture()
+            set_verbosity(level)
+            log_warning("W")
+            log_info("I")
+            log_debug("D")
+            got = {ln.strip()[-1] for ln in lines}
+            assert got == expect, f"verbosity={level}"
+
+    def test_fatal_raises_at_any_verbosity(self):
+        set_verbosity(-1)
+        with pytest.raises(LightGBMError, match="boom"):
+            log_fatal("boom")
+
+    def test_message_format(self):
+        lines = _capture()
+        set_verbosity(1)
+        log_info("hello")
+        assert lines == ["[LightGBM-TPU] [Info] hello\n"]
+
+
+class TestCallbackRedirection:
+    def test_redirect_and_restore(self, capsys):
+        set_verbosity(1)
+        lines = _capture()
+        log_info("redirected")
+        assert len(lines) == 1
+        assert capsys.readouterr().err == ""     # nothing hit stderr
+        register_log_callback(None)              # restore default sink
+        log_info("to stderr")
+        assert len(lines) == 1                   # callback no longer called
+        assert "to stderr" in capsys.readouterr().err
+
+
+class TestTimer:
+    def test_accumulates_and_counts(self):
+        t = Timer()
+        for _ in range(3):
+            t.start("a")
+            t.stop("a")
+        assert t.counts["a"] == 3
+        assert t.acc["a"] >= 0.0
+
+    def test_stop_without_start_is_noop(self):
+        t = Timer()
+        t.stop("never_started")          # must not raise
+        assert "never_started" not in t.acc
+        assert "never_started" not in t.counts
+
+    def test_report_includes_counts_and_mean(self):
+        t = Timer()
+        t.acc = {"hist": 1.2, "once": 0.5}
+        t.counts = {"hist": 240, "once": 1}
+        rep = t.report()
+        assert "hist=1.200s/240 (5.0ms)" in rep
+        assert "once=0.500s" in rep      # single call: no count suffix
+        assert "/1" not in rep
+
+    def test_reset(self):
+        t = Timer()
+        t.start("a")
+        t.stop("a")
+        t.start("pending")
+        t.reset()
+        assert t.acc == {} and t.counts == {} and t._t0 == {}
+
+    def test_stop_sync_blocks_when_sync_on(self, monkeypatch):
+        import jax
+        blocked = []
+        monkeypatch.setattr(jax, "block_until_ready", blocked.append)
+        t = Timer()
+        t.sync = True
+        t.start("x")
+        out = t.stop_sync("x", "devval")
+        assert out == "devval"
+        assert blocked == ["devval"]     # blocked BEFORE stopping the clock
+        assert t.counts["x"] == 1
+
+    def test_stop_sync_does_not_block_when_sync_off(self, monkeypatch):
+        import jax
+        def _boom(_):
+            raise AssertionError("must not block with sync=False")
+        monkeypatch.setattr(jax, "block_until_ready", _boom)
+        t = Timer()
+        t.start("x")
+        assert t.stop_sync("x", "devval") == "devval"
+        assert t.counts["x"] == 1
+
+    def test_stop_sync_none_value_never_blocks(self, monkeypatch):
+        import jax
+        monkeypatch.setattr(jax, "block_until_ready",
+                            lambda v: (_ for _ in ()).throw(
+                                AssertionError("blocked on None")))
+        t = Timer()
+        t.sync = True
+        t.start("x")
+        t.stop_sync("x", None)
+        assert t.counts["x"] == 1
+
+    def test_thread_safety(self):
+        t = Timer()
+        n_threads, n_iter = 8, 200
+
+        def work(i):
+            tag = f"tag{i}"
+            for _ in range(n_iter):
+                t.start(tag)
+                t.stop(tag)
+
+        threads = [threading.Thread(target=work, args=(i,))
+                   for i in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert sum(t.counts.values()) == n_threads * n_iter
+        assert all(t.counts[f"tag{i}"] == n_iter for i in range(n_threads))
+
+    def test_sink_receives_stops(self):
+        seen = []
+        log.set_timer_sink(lambda tag, dt: seen.append((tag, dt)))
+        try:
+            t = Timer()
+            t.start("a")
+            t.stop("a")
+            t.stop("a")              # no matching start: sink not called
+        finally:
+            log.set_timer_sink(None)
+        assert len(seen) == 1
+        assert seen[0][0] == "a" and seen[0][1] >= 0.0
