@@ -301,7 +301,8 @@ def synth_mslr(rows: int, cols: int = 136, n_queries: int = 6000,
     # per-query quality offset so ranking within query is what matters
     qoff = np.repeat(rng.standard_normal(n_queries, dtype=np.float32),
                      sizes)
-    util = (x @ w1) + 0.7 * np.abs(x @ w2) + 0.8 * qoff         + 0.9 * rng.standard_normal(total, dtype=np.float32)
+    util = ((x @ w1) + 0.7 * np.abs(x @ w2) + 0.8 * qoff
+            + 0.9 * rng.standard_normal(total, dtype=np.float32))
     # 5 relevance levels from global utility quantiles (skewed like MSLR)
     qs = np.quantile(util, [0.55, 0.75, 0.90, 0.97])
     y = np.digitize(util, qs).astype(np.float32)

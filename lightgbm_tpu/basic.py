@@ -8,8 +8,7 @@ the ctypes C API.
 
 from __future__ import annotations
 
-import copy
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -17,7 +16,7 @@ from .boosting import create_boosting
 from .boosting.gbdt import GBDT
 from .config import Config, normalize_params
 from .data.dataset import BinnedDataset, Metadata
-from .utils.log import LightGBMError, log_info, log_warning
+from .utils.log import LightGBMError
 
 __all__ = ["Dataset", "Booster", "LightGBMError"]
 

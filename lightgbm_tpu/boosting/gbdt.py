@@ -9,9 +9,8 @@ on-device tree traversal.  Model text format is the reference's "v2".
 
 from __future__ import annotations
 
-import math
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -20,13 +19,10 @@ import numpy as np
 from .. import obs
 from ..config import Config
 from ..data.dataset import BinnedDataset
-from ..metrics import create_metrics, create_metric
+from ..metrics import create_metrics
 from ..objectives import create_objective
-from ..ops.grow import (DeviceGrower, REC_F_FIELDS, REC_I_FIELDS,
-                        device_growth_eligible)
-from ..ops.histogram import bucket_size
-from ..ops.traverse import DeviceTree, add_tree_score, device_tree
-from ..tree.learner import SerialTreeLearner
+from ..ops.grow import DeviceGrower, device_growth_eligible
+from ..ops.traverse import add_tree_score, device_tree
 from ..tree.tree import Tree
 from ..utils.log import LightGBMError, log_info, log_warning
 from ..parallel import create_tree_learner
@@ -69,7 +65,7 @@ def _replay_records(rec_i, rec_f, rec_c, nl, shrinkage, bias, dataset,
                 float(v) for v in rec_f[s])
             real_f = dataset.used_features[f]
             mapper = dataset.bin_mappers[real_f]
-            missing = int(dataset.f_missing_type[f])
+            missing = dataset.f_missing_type[f]
             if is_cat_f[f]:
                 words = rec_c[s].astype(np.uint32)
                 member_bins = [
@@ -519,7 +515,10 @@ class GBDT:
         self._nl_queue.append(nls)
         if len(self._nl_queue) > 4:
             old = self._nl_queue.pop(0)
-            if old and all(int(np.asarray(v)) <= 1 for v in old):
+            # one batched fetch of the lagged handles (their async copies
+            # landed iterations ago) instead of a blocking per-class
+            # round trip
+            if old and max(jax.device_get(old)) <= 1:
                 self._trim_device_stumps()
                 return True
         return False
@@ -681,11 +680,14 @@ class GBDT:
                     v.score = v.score.at[idx % self.num_model].set(
                         add_tree_score(v.score[idx % self.num_model],
                                        v.binned_d, dt, 1.0))
-                elif abs(float(tree.leaf_value[0])) > K_EPSILON:
-                    # stump carrying the boost_from_average bias: apply
-                    # the constant (a 1-leaf traversal would do the same)
-                    v.score = v.score.at[idx % self.num_model].add(
-                        float(tree.leaf_value[0]))
+                else:
+                    # stump carrying the boost_from_average bias: one
+                    # host read reused for check and update (a 1-leaf
+                    # traversal would apply the same constant)
+                    stump = tree.leaf_value[0]
+                    if abs(stump) > K_EPSILON:
+                        v.score = v.score.at[idx % self.num_model].add(
+                            stump)
                 v.applied_models = idx + 1
 
     def _adjust_gradients(self, grad, hess):
@@ -714,7 +716,7 @@ class GBDT:
             residuals = label[rows] - score[rows]
             lw = w[rows] if w is not None else None
             tree.set_leaf_output(
-                leaf, obj.renew_tree_output(float(tree.leaf_value[leaf]),
+                leaf, obj.renew_tree_output(tree.leaf_value[leaf],
                                             residuals, lw))
 
     def update_score(self, tree: Tree, class_id: int):
@@ -849,7 +851,7 @@ class GBDT:
                         device_tree(tree, self.train_set,
                                     self.config.num_leaves), 1.0)
                 else:
-                    bias[k] += float(tree.leaf_value[0])
+                    bias[k] += tree.leaf_value[0]
         for k in range(self.num_model):
             out[k] = np.asarray(score[k], np.float64) + bias[k]
         return out
@@ -950,7 +952,7 @@ class GBDT:
         end_iter = total_iter if iteration <= 0 else min(iteration, total_iter)
         for tree in self.models[:end_iter * self.num_model]:
             for node in range(tree.num_leaves - 1):
-                f = int(tree.split_feature[node])
+                f = tree.split_feature[node]
                 if importance_type == "split":
                     out[f] += 1
                 else:
@@ -961,10 +963,12 @@ class GBDT:
     # model serialization (gbdt_model_text.cpp:243-330 format "v2")
     def model_to_string(self, start_iteration=0, num_iteration=-1) -> str:
         self._flush_pending()
+        label_index = (int(self.config.label_column or 0)
+                       if str(self.config.label_column).isdigit() else 0)
         lines = ["tree", f"version={MODEL_VERSION}",
                  f"num_class={max(int(self.config.num_class), 1)}",
                  f"num_tree_per_iteration={self.num_model}",
-                 f"label_index={int(self.config.label_column or 0) if str(self.config.label_column).isdigit() else 0}",
+                 f"label_index={label_index}",
                  f"max_feature_idx={self.max_feature_idx}"]
         if self.objective is not None:
             lines.append(f"objective={self.objective.to_string()}")
@@ -995,7 +999,8 @@ class GBDT:
         body += "end of trees\n"
         # feature importance block
         imps = self.feature_importance("split")
-        pairs = [(int(imps[i]), self.feature_names[i])
+        counts = imps.astype(np.int64)   # one conversion, not one per pair
+        pairs = [(counts[i], self.feature_names[i])
                  for i in np.argsort(-imps, kind="stable") if imps[i] > 0]
         body += "\nfeature importances:\n"
         for cnt, name in pairs:

@@ -3,7 +3,6 @@
 from __future__ import annotations
 
 import collections
-from typing import Callable, List
 
 from .utils.log import log_info, log_warning
 

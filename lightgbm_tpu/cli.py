@@ -9,7 +9,6 @@ every ``metric_freq`` iterations.  Entry: ``python -m lightgbm_tpu config=...``.
 
 from __future__ import annotations
 
-import os
 import sys
 import time
 from typing import Dict, List
@@ -19,10 +18,10 @@ import numpy as np
 from .boosting import create_boosting
 from .boosting.gbdt import GBDT
 from .config import Config, parse_config_str
-from .data.dataset import BinnedDataset, Metadata
+from .data.dataset import BinnedDataset
 from .data.parser import (load_init_score_file, load_query_file,
                           load_text_file, load_weight_file)
-from .utils.log import LightGBMError, log_info, log_warning
+from .utils.log import LightGBMError, log_info
 
 
 def parse_cli_args(argv: List[str]) -> Dict[str, str]:

@@ -9,7 +9,7 @@ metric from objective).  The schema lives in :mod:`lightgbm_tpu.params`.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterable, Mapping, Optional
+from typing import Any, Dict, Mapping, Optional
 
 from .params import (
     BOOSTING_ALIASES,

@@ -15,7 +15,7 @@ skipping: the TPU build keeps full dense histograms, so the reference's
 from __future__ import annotations
 
 import math
-from typing import List, Optional, Sequence
+from typing import List, Sequence
 
 import numpy as np
 

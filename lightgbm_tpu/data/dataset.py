@@ -19,20 +19,14 @@ reconstruction on device from leaf totals.
 from __future__ import annotations
 
 import pickle
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 from ..config import Config
 from ..utils.log import LightGBMError, log_info, log_warning
 from ..utils.random import make_rng
-from .binning import (
-    BIN_CATEGORICAL,
-    BIN_NUMERICAL,
-    MISSING_NAN,
-    MISSING_ZERO,
-    BinMapper,
-)
+from .binning import BIN_CATEGORICAL, BIN_NUMERICAL, BinMapper
 
 MAX_GROUP_BIN = 256   # static histogram bin axis on device
 BINARY_MAGIC = b"LIGHTGBM_TPU_DATASET_V1\n"

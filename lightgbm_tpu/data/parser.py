@@ -9,12 +9,11 @@ available fallback and the single source of semantics.
 
 from __future__ import annotations
 
-import os
 from typing import List, Optional, Tuple
 
 import numpy as np
 
-from ..utils.log import LightGBMError, log_info, log_warning
+from ..utils.log import log_info
 
 
 def _sniff(lines: List[str]) -> str:

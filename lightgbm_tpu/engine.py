@@ -4,8 +4,7 @@
 from __future__ import annotations
 
 import collections
-import copy
-from typing import Any, Dict, List, Optional
+from typing import List
 
 import numpy as np
 

@@ -7,7 +7,7 @@ reference's class-major flat layout.  ``get_gradients`` returns device
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax.numpy as jnp
 import numpy as np

@@ -45,7 +45,7 @@ monotone constraints, forced splits, renew-tree-output objectives.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Tuple
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp

@@ -11,7 +11,6 @@ the training partition is unavailable.
 
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple
 
 import jax

@@ -31,10 +31,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from ..ops.split import (FeatureMeta, NEG_INF, SplitHyper,
-                         feature_histograms, gather_feature_histograms,
-                         masked_feature_gain, min_gain_shift_of, pack_best,
-                         per_feature_best, reconstruct_default)
+from ..ops.split import (FeatureMeta, NEG_INF, feature_histograms,
+                         gather_feature_histograms, masked_feature_gain,
+                         min_gain_shift_of, pack_best, per_feature_best,
+                         reconstruct_default)
 from ..tree.learner import _LeafInfo
 from .data_parallel import DataParallelTreeLearner
 from .network import Network

@@ -6,7 +6,6 @@ import numpy as np
 
 from .basic import Booster
 from .sklearn import LGBMModel
-from .utils.log import LightGBMError
 
 __all__ = ["plot_importance", "plot_metric", "plot_tree", "create_tree_digraph"]
 

@@ -3,15 +3,14 @@
 
 from __future__ import annotations
 
-import copy
 from inspect import signature
-from typing import Any, Callable, Dict, Optional
+from typing import Optional
 
 import numpy as np
 
 from .basic import Booster, Dataset
 from .engine import train
-from .utils.log import LightGBMError, log_warning
+from .utils.log import LightGBMError
 
 __all__ = ["LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker"]
 

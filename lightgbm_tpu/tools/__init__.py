@@ -1,0 +1,2 @@
+"""Developer tooling that ships with the package (static analysis,
+codegen helpers).  Nothing here is imported by the runtime library."""

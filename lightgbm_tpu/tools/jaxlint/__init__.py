@@ -1,0 +1,28 @@
+"""jaxlint: repo-aware static analysis for JAX performance hazards.
+
+Rules (docs/StaticAnalysis.md has bad/good examples for each):
+
+- **JL001** host-device sync inside hot-path loops
+- **JL002** recompile hazards around ``jax.jit``
+- **JL003** jitted callables not registered with ``obs.track_jit``
+- **JL004** float64 flowing into device code while x64 is disabled
+- **JL005** set iteration order leaking into output
+- **JL006** unguarded mutation of module-level state
+
+CLI: ``python -m lightgbm_tpu.tools.jaxlint [paths] [--baseline ...]``.
+Inline suppression: ``# jaxlint: disable=JL001`` (same line) or
+``# jaxlint: disable-next=JL001`` (next line).  Pre-existing findings
+live in the committed ``jaxlint_baseline.json``; new ones fail CI
+(``scripts/check.sh``, ``tests/test_jaxlint.py``).
+"""
+
+from .baseline import DEFAULT_BASELINE, apply, dump, finding_key, load, write
+from .context import FileContext, Finding
+from .core import AnalysisResult, analyze_paths, analyze_source
+from .rules import RULE_DOCS, RULES
+
+__all__ = [
+    "AnalysisResult", "DEFAULT_BASELINE", "FileContext", "Finding",
+    "RULES", "RULE_DOCS", "analyze_paths", "analyze_source", "apply",
+    "dump", "finding_key", "load", "write",
+]

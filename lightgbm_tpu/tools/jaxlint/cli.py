@@ -1,0 +1,138 @@
+"""jaxlint command line: ``python -m lightgbm_tpu.tools.jaxlint [paths]``.
+
+Exit codes: 0 clean (every finding baselined or none), 1 new findings,
+2 usage/parse errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import Counter
+from typing import List, Optional
+
+from . import baseline as baseline_mod
+from .core import analyze_paths
+from .rules import RULE_DOCS
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="jaxlint",
+        description="Repo-aware static analysis for host-sync, recompile "
+                    "and dtype hazards in JAX code.")
+    p.add_argument("paths", nargs="*", default=["lightgbm_tpu"],
+                   help="files/directories to analyze "
+                        "(default: lightgbm_tpu)")
+    p.add_argument("--baseline", default=None, metavar="PATH",
+                   help="baseline JSON of accepted findings (default: "
+                        f"./{baseline_mod.DEFAULT_BASELINE} when present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline; report every finding")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write the current findings as the baseline and "
+                        "exit 0")
+    p.add_argument("--select", default=None, metavar="CODES",
+                   help="comma-separated rule codes to run "
+                        "(e.g. JL001,JL005)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--statistics", action="store_true",
+                   help="print per-rule counts")
+    p.add_argument("--list-rules", action="store_true",
+                   help="list rule codes and exit")
+    p.add_argument("--root", default=None, metavar="DIR",
+                   help="directory finding paths are reported relative "
+                        "to (default: cwd)")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for code, doc in RULE_DOCS.items():
+            print(f"{code}  {doc}")
+        return 0
+
+    select = None
+    if args.select:
+        select = {c.strip().upper() for c in args.select.split(",")}
+        unknown = select - set(RULE_DOCS)
+        if unknown:
+            print(f"jaxlint: unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    result = analyze_paths(args.paths, root=args.root, select=select)
+    if result.errors:
+        for path, msg in result.errors:
+            print(f"{path}: error: {msg}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline
+    if baseline_path is None and not args.no_baseline:
+        default = baseline_mod.DEFAULT_BASELINE
+        if args.root is not None:
+            default = os.path.join(args.root, default)
+        if os.path.exists(default):
+            baseline_path = default
+
+    if args.write_baseline:
+        if select is not None:
+            # a rule-filtered run only holds the selected findings;
+            # writing it would silently drop every other accepted entry
+            print("jaxlint: --write-baseline cannot be combined with "
+                  "--select (it would erase the other rules' baseline "
+                  "entries); run without --select", file=sys.stderr)
+            return 2
+        out = baseline_path or (
+            os.path.join(args.root, baseline_mod.DEFAULT_BASELINE)
+            if args.root else baseline_mod.DEFAULT_BASELINE)
+        baseline_mod.write(out, result.findings)
+        print(f"jaxlint: wrote {len(result.findings)} finding(s) to {out}")
+        return 0
+
+    accepted = {}
+    if baseline_path and not args.no_baseline:
+        try:
+            accepted = baseline_mod.load(baseline_path)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"jaxlint: cannot read baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+    new, stale = baseline_mod.apply(result.findings, accepted)
+
+    if args.format == "json":
+        print(json.dumps({
+            "files_scanned": result.files_scanned,
+            "total": len(result.findings),
+            "new": [f.to_dict() for f in new],
+            "baselined": len(result.findings) - len(new),
+            "suppressed": len(result.suppressed),
+            "stale_baseline_entries": [
+                {"file": k[0], "rule": k[1], "snippet": k[2], "count": n}
+                for k, n in stale],
+        }, indent=1))
+    else:
+        for f in new:
+            print(f"{f.path}:{f.line}:{f.col + 1}: {f.rule} {f.message}")
+        if args.statistics and result.findings:
+            counts = Counter(f.rule for f in result.findings)
+            for code in sorted(counts):
+                print(f"{code}: {counts[code]} total")
+        summary = (f"jaxlint: {result.files_scanned} file(s), "
+                   f"{len(result.findings)} finding(s): {len(new)} new, "
+                   f"{len(result.findings) - len(new)} baselined, "
+                   f"{len(result.suppressed)} suppressed")
+        if stale:
+            summary += (f"; {sum(n for _, n in stale)} stale baseline "
+                        "entr(ies) — regenerate with --write-baseline")
+        print(summary)
+
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

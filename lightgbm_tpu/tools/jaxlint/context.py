@@ -1,0 +1,314 @@
+"""Shared per-file analysis context for the jaxlint rules.
+
+One :class:`FileContext` is built per analyzed file: the parsed AST with
+parent links, the import alias tables (``numpy``/``jax``/``jax.numpy``
+under whatever names the module bound them to), per-scope "device name"
+dataflow (names assigned from ``jnp.``/``jax.``-rooted expressions),
+loop-nesting queries with comprehension-aware semantics, inline
+suppression comments, and the hot-path classification that scopes JL001.
+
+The context is pure ``ast`` — no imports of the analyzed code are ever
+executed, so the analyzer runs on files with unimportable dependencies
+and never pays jax start-up cost per file.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+#: modules whose loops are the retrain-every-window hot path (PAPER.md's
+#: LRB harness drives these once per window); JL001 only fires here.  A
+#: module can opt in from outside this list with a ``# jaxlint: hot-path``
+#: marker comment anywhere in the file.
+HOT_PATH_SUFFIXES = (
+    "lightgbm_tpu/boosting/gbdt.py",
+    "lightgbm_tpu/tree/learner.py",
+    "lightgbm_tpu/engine.py",
+    "lightgbm_tpu/capi_embed.py",
+)
+
+HOT_MARKER = "jaxlint: hot-path"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*(disable|disable-next)\s*=\s*"
+    r"(all|[A-Za-z]{2}\d{3}(?:\s*,\s*[A-Za-z]{2}\d{3})*)")
+
+_COMPREHENSIONS = (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.Module)
+
+
+class Finding:
+    """One rule violation at a source location."""
+
+    __slots__ = ("rule", "path", "line", "col", "message", "snippet")
+
+    def __init__(self, rule: str, path: str, line: int, col: int,
+                 message: str, snippet: str):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.col = col
+        self.message = message
+        self.snippet = snippet
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.rule)
+
+    def to_dict(self) -> Dict:
+        return {"rule": self.rule, "file": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "snippet": self.snippet}
+
+    def __repr__(self):
+        return (f"Finding({self.rule} {self.path}:{self.line}:{self.col} "
+                f"{self.message!r})")
+
+
+def normalize_snippet(line: str, width: int = 200) -> str:
+    """Whitespace-collapsed source line: the line-number-independent
+    baseline key, stable across pure line moves."""
+    return " ".join(line.split())[:width]
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def chain_root(node: ast.AST) -> Optional[str]:
+    """Base Name id of a Call/Attribute/Subscript chain, else None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+        node = node.func if isinstance(node, ast.Call) else node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class FileContext:
+    """Everything the rule modules need to know about one source file."""
+
+    def __init__(self, src: str, relpath: str):
+        self.src = src
+        self.relpath = relpath.replace("\\", "/")
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src, filename=relpath)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.numpy_aliases: Set[str] = set()
+        self.jax_aliases: Set[str] = set()
+        self.jnp_aliases: Set[str] = set()
+        self.partial_names: Set[str] = set()    # functools.partial bindings
+        self.jit_names: Set[str] = set()        # `from jax import jit` names
+        self._collect_imports()
+        self.is_hot = (HOT_MARKER in src
+                       or any(self.relpath.endswith(s)
+                              for s in HOT_PATH_SUFFIXES))
+        self.suppressions: Dict[int, Set[str]] = {}
+        self._collect_suppressions()
+        self._device_cache: Dict[int, Set[str]] = {}
+        self._set_cache: Dict[int, Set[str]] = {}
+
+    # ------------------------------------------------------------------
+    def _collect_imports(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    bound = a.asname or a.name.split(".")[0]
+                    if a.name == "numpy":
+                        self.numpy_aliases.add(bound)
+                    elif a.name == "jax.numpy" and a.asname:
+                        self.jnp_aliases.add(bound)
+                    elif a.name.split(".")[0] == "jax":
+                        self.jax_aliases.add(bound)
+                    elif a.name == "functools":
+                        self.partial_names.add(f"{bound}.partial")
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "jax":
+                    for a in node.names:
+                        if a.name == "numpy":
+                            self.jnp_aliases.add(a.asname or "numpy")
+                        elif a.name == "jit":
+                            self.jit_names.add(a.asname or "jit")
+                elif node.module == "functools":
+                    for a in node.names:
+                        if a.name == "partial":
+                            self.partial_names.add(a.asname or "partial")
+
+    def _collect_suppressions(self):
+        for i, line in enumerate(self.lines, 1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            codes = {c.strip().upper() for c in m.group(2).split(",")}
+            target = i if m.group(1) == "disable" else i + 1
+            self.suppressions.setdefault(target, set()).update(codes)
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        codes = self.suppressions.get(line)
+        return bool(codes) and (rule in codes or "ALL" in codes)
+
+    # ------------------------------------------------------------------
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self.parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        p = self.parents.get(node)
+        while p is not None:
+            yield p
+            p = self.parents.get(p)
+
+    def is_ancestor(self, maybe_ancestor: ast.AST, node: ast.AST) -> bool:
+        return any(a is maybe_ancestor for a in self.ancestors(node))
+
+    def enclosing_scope(self, node: ast.AST) -> ast.AST:
+        for a in self.ancestors(node):
+            if isinstance(a, _SCOPES):
+                return a
+        return self.tree
+
+    def loop_depth(self, node: ast.AST) -> int:
+        """Number of enclosing loops whose BODY re-evaluates ``node``
+        each iteration, up to the nearest function boundary.  A ``for``
+        statement's iterable and a comprehension's FIRST source iterable
+        are evaluated once, so they don't count."""
+        depth = 0
+        child = node
+        for p in self.ancestors(node):
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                break
+            if isinstance(p, ast.For):
+                if child is not p.iter:
+                    depth += 1
+            elif isinstance(p, ast.While):
+                depth += 1
+            elif isinstance(p, _COMPREHENSIONS):
+                if not (p.generators and child is p.generators[0].iter):
+                    depth += 1
+            child = p
+        return depth
+
+    # ------------------------------------------------------------------
+    def rooted_in(self, node: ast.AST, roots: Set[str]) -> bool:
+        r = chain_root(node)
+        return r is not None and r in roots
+
+    def device_names(self, node: ast.AST) -> Set[str]:
+        """Names in ``node``'s scope assigned from ``jnp.``/``jax.``-rooted
+        expressions — a cheap local dataflow for "this is (probably) a
+        device array"."""
+        scope = self.enclosing_scope(node)
+        cached = self._device_cache.get(id(scope))
+        if cached is not None:
+            return cached
+        roots = self.jnp_aliases | self.jax_aliases
+        names: Set[str] = set()
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name) \
+                    and self.rooted_in(n.value, roots):
+                names.add(n.targets[0].id)
+        self._device_cache[id(scope)] = names
+        return names
+
+    def set_names(self, node: ast.AST) -> Set[str]:
+        """Names in ``node``'s scope assigned from set expressions."""
+        scope = self.enclosing_scope(node)
+        cached = self._set_cache.get(id(scope))
+        if cached is not None:
+            return cached
+        names: Set[str] = set()
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name):
+                v = n.value
+                if isinstance(v, (ast.Set, ast.SetComp)) or (
+                        isinstance(v, ast.Call)
+                        and isinstance(v.func, ast.Name)
+                        and v.func.id in ("set", "frozenset")):
+                    names.add(n.targets[0].id)
+        self._set_cache[id(scope)] = names
+        return names
+
+    # ------------------------------------------------------------------
+    def is_jit_expr(self, node: ast.AST) -> bool:
+        """``jax.jit`` (or an imported alias of it) as an expression."""
+        if isinstance(node, ast.Name):
+            return node.id in self.jit_names
+        d = dotted_name(node)
+        return d is not None and any(d == f"{j}.jit"
+                                     for j in self.jax_aliases)
+
+    def is_jit_call(self, node: ast.AST) -> bool:
+        """``jax.jit(...)`` call expression."""
+        return isinstance(node, ast.Call) and self.is_jit_expr(node.func)
+
+    def jit_decorator_statics(
+            self, dec: ast.AST) -> Optional[Tuple[Set[int], Set[str]]]:
+        """(static_argnums, static_argnames) when ``dec`` is a jit-family
+        decorator: ``@jax.jit``, ``@jax.jit(...)`` or
+        ``@functools.partial(jax.jit, ...)``; None otherwise."""
+        if self.is_jit_expr(dec):
+            return set(), set()
+        if not isinstance(dec, ast.Call):
+            return None
+        if self.is_jit_expr(dec.func):
+            return self._parse_statics(dec.keywords)
+        d = dotted_name(dec.func)
+        if d in self.partial_names and dec.args \
+                and self.is_jit_expr(dec.args[0]):
+            return self._parse_statics(dec.keywords)
+        return None
+
+    @staticmethod
+    def _parse_statics(keywords) -> Tuple[Set[int], Set[str]]:
+        nums: Set[int] = set()
+        names: Set[str] = set()
+        for kw in keywords:
+            if kw.arg == "static_argnums":
+                nums |= set(_literal_ints(kw.value))
+            elif kw.arg == "static_argnames":
+                names |= set(_literal_strs(kw.value))
+        return nums, names
+
+    # ------------------------------------------------------------------
+    def make_finding(self, rule: str, node: ast.AST, message: str) \
+            -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        snippet = normalize_snippet(self.lines[line - 1]) \
+            if 0 < line <= len(self.lines) else ""
+        return Finding(rule, self.relpath, line, col, message, snippet)
+
+
+def _literal_ints(node) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            out.extend(_literal_ints(e))
+        return out
+    return []
+
+
+def _literal_strs(node) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            out.extend(_literal_strs(e))
+        return out
+    return []

@@ -1,0 +1,91 @@
+"""jaxlint driver: walk files, run rules, apply inline suppressions.
+
+Pure static analysis — files are parsed with :mod:`ast`, never imported,
+so the analyzer is fast (~60 files in well under a second) and safe to
+run on code whose dependencies are absent.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Iterable, List, Optional, Sequence, Set, Tuple
+
+from .context import FileContext, Finding
+from .rules import RULES
+
+EXCLUDED_DIRS = {"__pycache__", ".git", ".venv", "build", "dist"}
+
+
+class AnalysisResult:
+    """Findings plus bookkeeping from one analyzer run."""
+
+    __slots__ = ("findings", "suppressed", "files_scanned", "errors")
+
+    def __init__(self):
+        self.findings: List[Finding] = []
+        self.suppressed: List[Finding] = []
+        self.files_scanned: int = 0
+        self.errors: List[Tuple[str, str]] = []   # (path, message)
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterable[Path]:
+    for raw in paths:
+        p = Path(raw)
+        if p.is_file():
+            if p.suffix == ".py":
+                yield p
+        elif p.is_dir():
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in EXCLUDED_DIRS)
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield Path(dirpath) / fn
+
+
+def analyze_source(src: str, relpath: str,
+                   select: Optional[Set[str]] = None,
+                   result: Optional[AnalysisResult] = None) \
+        -> AnalysisResult:
+    """Run all (or ``select``ed) rules over one source string."""
+    result = result if result is not None else AnalysisResult()
+    try:
+        ctx = FileContext(src, relpath)
+    except SyntaxError as e:
+        result.errors.append((relpath, f"syntax error: {e.msg} "
+                              f"(line {e.lineno})"))
+        return result
+    result.files_scanned += 1
+    for code, rule in RULES.items():
+        if select is not None and code not in select:
+            continue
+        for finding in rule.check(ctx):
+            if ctx.is_suppressed(finding.rule, finding.line):
+                result.suppressed.append(finding)
+            else:
+                result.findings.append(finding)
+    result.findings.sort(key=Finding.sort_key)
+    return result
+
+
+def analyze_paths(paths: Sequence[str], root: Optional[str] = None,
+                  select: Optional[Set[str]] = None) -> AnalysisResult:
+    """Analyze every ``.py`` file under ``paths``.  Finding paths are
+    reported relative to ``root`` (default: cwd) when possible, so the
+    baseline is position-independent."""
+    rootp = Path(root) if root is not None else Path.cwd()
+    result = AnalysisResult()
+    for path in iter_python_files(paths):
+        try:
+            rel = path.resolve().relative_to(rootp.resolve()).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        try:
+            src = path.read_text(encoding="utf-8")
+        except OSError as e:
+            result.errors.append((rel, str(e)))
+            continue
+        analyze_source(src, rel, select=select, result=result)
+    result.findings.sort(key=Finding.sort_key)
+    return result

@@ -1,0 +1,20 @@
+"""Rule registry: JLxxx code -> (checker, one-line description).
+
+Each rule module exposes ``CODE``, ``SHORT`` and ``check(ctx)`` yielding
+:class:`~..context.Finding` objects.  Registration is explicit (no
+import-time magic) so the set of shipped rules is grep-able here.
+"""
+
+from __future__ import annotations
+
+from . import (dtype_drift, global_state, host_sync, jit_registry,
+               recompile, set_order)
+
+_MODULES = (host_sync, recompile, jit_registry, dtype_drift, set_order,
+            global_state)
+
+#: code -> rule module, in code order
+RULES = {m.CODE: m for m in _MODULES}
+
+#: code -> one-line description (CLI --list-rules, docs)
+RULE_DOCS = {m.CODE: m.SHORT for m in _MODULES}

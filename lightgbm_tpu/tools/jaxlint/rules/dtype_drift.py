@@ -1,0 +1,57 @@
+"""JL004 — float64 flowing into device code while x64 is disabled.
+
+The package runs with JAX's default x64-disabled config: a
+``np.float64``/``"float64"`` dtype handed to a ``jnp.`` constructor is
+silently truncated to float32 — the code *reads* like it computes in
+double but doesn't, and if x64 were ever enabled the same line would
+double every buffer and recompile every consumer.  Host-side float64
+(``np.asarray(x, np.float64)`` for metrics/model text) is deliberate
+and exempt: only ``jnp.``-rooted calls are checked.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..context import FileContext, chain_root, dotted_name
+
+CODE = "JL004"
+SHORT = ("float64 dtype passed into jnp device code while x64 is "
+         "disabled (silent truncation to float32)")
+
+
+def _is_f64_marker(ctx: FileContext, node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and node.value == "float64":
+        return True
+    if isinstance(node, ast.Attribute) and node.attr in ("float64",
+                                                         "int64"):
+        root = chain_root(node)
+        return root in ctx.numpy_aliases or root in ctx.jnp_aliases
+    return False
+
+
+def check(ctx: FileContext):
+    if not ctx.jnp_aliases:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted_name(node.func)
+        if d is None or chain_root(node.func) not in ctx.jnp_aliases:
+            continue
+        if d.split(".")[-1] in ("float64", "int64"):
+            yield ctx.make_finding(
+                CODE, node,
+                f"`{d}(...)` under disabled x64 silently produces 32-bit "
+                "values; use the 32-bit dtype explicitly or keep the "
+                "value on host")
+            continue
+        for sub in list(node.args) + [kw.value for kw in node.keywords]:
+            for leaf in ast.walk(sub):
+                if _is_f64_marker(ctx, leaf):
+                    yield ctx.make_finding(
+                        CODE, leaf,
+                        f"64-bit dtype passed into `{d}(...)` while x64 "
+                        "is disabled: the array is silently truncated to "
+                        "32-bit; spell the 32-bit dtype or do the f64 "
+                        "math on host")

@@ -1,0 +1,121 @@
+"""JL001 — host-device synchronization inside hot-path loops.
+
+The retrain-every-window harness (PAPER.md) multiplies every
+per-iteration host transfer by thousands of windows: a stray
+``float(device_scalar)`` or ``np.asarray(device_array)`` inside the
+boosting loop serializes the async dispatch pipeline once per tree.
+This rule fires only in hot-path modules (``context.HOT_PATH_SUFFIXES``
+or a ``# jaxlint: hot-path`` marker) and only inside loops — module-level
+or once-per-call transfers are fine.
+
+Detected shapes, in a loop body:
+
+- ``x.item()`` — the canonical single-value sync.
+- ``float(e)`` / ``int(e)`` / ``bool(e)`` where ``e`` contains a
+  ``jnp.``/``jax.``-rooted expression, a name locally assigned from one,
+  or an ``np.asarray(...)`` transfer.
+- ``float(x[i])``-style scalar reads (subscript argument): per-iteration
+  scalar extraction; hoist or batch the read.
+- ``np.asarray(x)`` / ``jax.device_get(x)`` of a (probable) device value.
+
+Fix patterns: batch handles with one ``jax.device_get(list)`` outside
+the loop (gbdt.py's nl-queue stall check), hoist the scalar read, or
+keep the value on device.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..context import FileContext, chain_root, dotted_name
+
+CODE = "JL001"
+SHORT = ("host-device sync inside a hot-path loop "
+         "(.item()/float()/np.asarray of device values)")
+
+_CASTS = ("float", "int", "bool")
+
+
+def _contains_transfer_source(ctx: FileContext, node: ast.AST,
+                              device_names) -> bool:
+    """Does ``node``'s subtree reference something device-resident: a
+    jnp/jax-rooted expression, a locally device-assigned name, or an
+    np.asarray transfer?"""
+    roots = ctx.jnp_aliases | ctx.jax_aliases
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            if sub.id in roots or sub.id in device_names:
+                # metadata reads (x.shape, x.ndim, x.dtype) are host-side
+                # statics — no transfer happens
+                parent = ctx.parent(sub)
+                if isinstance(parent, ast.Attribute) and parent.attr in (
+                        "shape", "ndim", "dtype", "size"):
+                    continue
+                return True
+        elif isinstance(sub, ast.Call):
+            d = dotted_name(sub.func)
+            if d and any(d == f"{np}.asarray" for np in ctx.numpy_aliases):
+                return True
+    return False
+
+
+def _classify(ctx: FileContext, call: ast.Call, device_names):
+    func = call.func
+    # x.item()
+    if isinstance(func, ast.Attribute) and func.attr == "item" \
+            and not call.args and not call.keywords:
+        return (".item() forces a host-device sync every loop iteration; "
+                "batch the values and fetch once outside the loop "
+                "(jax.device_get on the whole list)")
+    # float()/int()/bool() of a device-ish expression or a subscript read
+    if isinstance(func, ast.Name) and func.id in _CASTS and len(call.args) == 1:
+        arg = call.args[0]
+        if _contains_transfer_source(ctx, arg, device_names):
+            return (f"{func.id}() of a device value inside a loop blocks "
+                    "on the transfer each iteration; hoist or batch the "
+                    "host read")
+        if isinstance(arg, ast.Subscript):
+            # x.shape[0] / x.strides[1] are host-side metadata, not reads
+            if isinstance(arg.value, ast.Attribute) and arg.value.attr in (
+                    "shape", "strides", "ndim"):
+                return None
+            return (f"per-iteration scalar read {func.id}(...[...]) in a "
+                    "hot loop; hoist the conversion out of the loop or "
+                    "read the whole array once")
+        return None
+    # np.asarray(x) / jax.device_get(x) of a device value
+    d = dotted_name(func)
+    if d is None:
+        return None
+    is_asarray = any(d == f"{np}.asarray" for np in ctx.numpy_aliases)
+    is_devget = any(d == f"{j}.device_get" for j in ctx.jax_aliases)
+    if (is_asarray or is_devget) and call.args:
+        arg = call.args[0]
+        roots = ctx.jnp_aliases | ctx.jax_aliases
+        argroot = chain_root(arg)
+        if (argroot in device_names or argroot in roots
+                or _contains_transfer_source(ctx, arg, device_names)):
+            return (f"{d}() of a device array inside a loop is one "
+                    "blocking transfer per iteration; start the copies "
+                    "async and fetch them batched after the loop")
+    return None
+
+
+def check(ctx: FileContext):
+    if not ctx.is_hot:
+        return
+    reported = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if ctx.loop_depth(node) < 1:
+            continue
+        # a call nested inside an already-reported call (e.g. the
+        # np.asarray inside int(np.asarray(v))) is the same sync
+        if any(ctx.is_ancestor(r, node) for r in reported):
+            continue
+        device_names = ctx.device_names(node)
+        msg = _classify(ctx, node, device_names)
+        if msg is not None:
+            reported.append(node)
+            yield ctx.make_finding(CODE, node, msg)

@@ -1,0 +1,88 @@
+"""JL003 — ``jax.jit`` callables invisible to the recompile tracker.
+
+PR 1's ``obs/jit_track.py`` attributes every XLA compile to a named
+shape signature; a jitted callable that never passes through
+``obs.track_jit`` compiles silently, and the per-window recompile
+telemetry (the whole point of the tracker in the retrain-every-window
+harness) under-counts.  This rule finds jit bindings in a module and
+checks each is registered:
+
+- ``name = obs.track_jit("name", jax.jit(f))`` — tracked at creation.
+- ``@jax.jit``-decorated ``f`` later rebound via
+  ``f = obs.track_jit("f", f)`` — tracked by rebind.
+- anything else — finding.
+
+Suppress for callables that compile exactly once by construction (cold
+helpers, test fixtures) with ``# jaxlint: disable=JL003``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from ..context import FileContext, dotted_name
+
+CODE = "JL003"
+SHORT = ("jax.jit callable not registered with obs.track_jit "
+         "(compiles invisible to the recompile telemetry)")
+
+
+def _is_track_jit_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = dotted_name(node.func)
+    return d is not None and d.split(".")[-1] == "track_jit"
+
+
+def check(ctx: FileContext):
+    # names (or dotted attribute targets) that flow through track_jit
+    tracked: set = set()
+    for node in ast.walk(ctx.tree):
+        if _is_track_jit_call(node):
+            for a in node.args[1:]:
+                d = dotted_name(a)
+                if d is not None:
+                    tracked.add(d)
+
+    # jit bindings: (reported name, node to attach the finding to)
+    bindings: List[Tuple[str, ast.AST]] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                if ctx.jit_decorator_statics(dec) is not None:
+                    bindings.append((node.name, dec))
+                    break
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and ctx.is_jit_call(node.value):
+            target = dotted_name(node.targets[0])
+            if target is not None:
+                bindings.append((target, node.value))
+        elif ctx.is_jit_call(node):
+            # a bare jax.jit(...) expression: tracked when nested inside
+            # a track_jit(...) call; assigned/decorator cases are handled
+            # above; an immediately-invoked jit is JL002's finding
+            parent = ctx.parent(node)
+            if isinstance(parent, ast.Assign) or _in_track_jit(ctx, node) \
+                    or (isinstance(parent, ast.Call)
+                        and parent.func is node):
+                continue
+            bindings.append((dotted_name(node.func) or "jax.jit",
+                             node))
+
+    seen: Dict[int, bool] = {}
+    for name, node in bindings:
+        if id(node) in seen:
+            continue
+        seen[id(node)] = True
+        if name in tracked or _in_track_jit(ctx, node):
+            continue
+        yield ctx.make_finding(
+            CODE, node,
+            f"jitted callable `{name}` is not wrapped with obs.track_jit; "
+            "its recompiles are invisible to the shape-signature tracker "
+            "(obs/jit_track.py)")
+
+
+def _in_track_jit(ctx: FileContext, node: ast.AST) -> bool:
+    return any(_is_track_jit_call(a) for a in ctx.ancestors(node))

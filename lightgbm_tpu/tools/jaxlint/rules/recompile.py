@@ -1,0 +1,172 @@
+"""JL002 — XLA recompile hazards around ``jax.jit``.
+
+Every distinct jit signature is a full trace + XLA compile; in the
+windowed harness a signature that churns per window turns "training"
+into "compiling" (the PR-1 telemetry counts exactly this).  Three
+statically visible hazard shapes:
+
+1. **Weak-type churn at call sites**: a Python scalar or dict literal
+   passed positionally/by keyword to a same-module jitted function at a
+   position not declared in ``static_argnums``/``static_argnames``.
+   Python scalars trace as weak-typed 0-d arrays whose signature differs
+   from the arrays the same slot sees elsewhere, and dicts hash into the
+   static side only when declared static.
+2. **Python branches on traced values**: an ``if``/``while`` inside a
+   jitted function whose test reads a non-static parameter's *value*
+   (`x is None` checks and ``x.shape``/``x.ndim``/``x.dtype``/``len(x)``
+   reads are static and exempt) — these raise TracerBoolConversionError
+   at best, silently specialize at worst.
+3. **Immediately-invoked jit**: ``jax.jit(fn)(args)`` builds a fresh jit
+   object — and a fresh empty compile cache — per call, recompiling
+   every time.  Hoist the jitted callable to module/instance scope.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..context import FileContext
+
+CODE = "JL002"
+SHORT = ("recompile hazard: non-static Python scalar/dict jit args, "
+         "Python branch on a traced value, or jax.jit(f)(x) per call")
+
+
+class _JitFn:
+    __slots__ = ("name", "node", "static_pos", "static_names", "params")
+
+    def __init__(self, name: str, node: Optional[ast.FunctionDef],
+                 static_pos: Set[int], static_names: Set[str]):
+        self.name = name
+        self.node = node
+        self.static_pos = static_pos
+        self.static_names = static_names
+        self.params: List[str] = []
+        if node is not None:
+            self.params = [a.arg for a in node.args.args]
+
+    def is_static(self, pos: Optional[int], name: Optional[str]) -> bool:
+        if pos is not None and pos in self.static_pos:
+            return True
+        if name is not None and name in self.static_names:
+            return True
+        if pos is not None and pos < len(self.params) \
+                and self.params[pos] in self.static_names:
+            return True
+        if name is not None and name in self.params \
+                and self.params.index(name) in self.static_pos:
+            return True
+        return False
+
+
+def _collect_jitted(ctx: FileContext) -> Dict[str, _JitFn]:
+    out: Dict[str, _JitFn] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                statics = ctx.jit_decorator_statics(dec)
+                if statics is not None:
+                    out[node.name] = _JitFn(node.name, node, *statics)
+                    break
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and ctx.is_jit_call(node.value):
+            nums, names = ctx._parse_statics(node.value.keywords)
+            out[node.targets[0].id] = _JitFn(node.targets[0].id, None,
+                                             nums, names)
+    return out
+
+
+def _is_hazard_literal(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) \
+            and isinstance(node.value, (bool, int, float)):
+        return "Python scalar"
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub) \
+            and isinstance(node.operand, ast.Constant) \
+            and isinstance(node.operand.value, (int, float)):
+        return "Python scalar"
+    if isinstance(node, ast.Dict):
+        return "dict"
+    return None
+
+
+def _static_value_read(ctx: FileContext, name_node: ast.Name) -> bool:
+    """x.shape / x.ndim / x.dtype / len(x) / `x is None` are trace-time
+    statics, not value reads."""
+    parent = ctx.parent(name_node)
+    if isinstance(parent, ast.Attribute) and parent.attr in (
+            "shape", "ndim", "dtype", "size", "weak_type"):
+        return True
+    if isinstance(parent, ast.Call) and isinstance(parent.func, ast.Name) \
+            and parent.func.id in ("len", "isinstance", "type") \
+            and name_node in parent.args:
+        return True
+    if isinstance(parent, ast.Compare) and any(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in parent.ops):
+        return True
+    return False
+
+
+def check(ctx: FileContext):
+    jitted = _collect_jitted(ctx)
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # (3) immediately-invoked jit: jax.jit(fn)(...)
+        if ctx.is_jit_call(node.func):
+            yield ctx.make_finding(
+                CODE, node,
+                "jax.jit(...) invoked immediately builds a fresh compile "
+                "cache per call (recompiles every time); bind the jitted "
+                "callable once at module/instance scope")
+            continue
+        # (1) literal scalar/dict at a non-static slot of a known jit fn
+        if not isinstance(node.func, ast.Name):
+            continue
+        fn = jitted.get(node.func.id)
+        if fn is None:
+            continue
+        for i, arg in enumerate(node.args):
+            kind = _is_hazard_literal(arg)
+            if kind and not fn.is_static(i, None):
+                yield ctx.make_finding(
+                    CODE, arg,
+                    f"{kind} passed as traced argument {i} of jitted "
+                    f"`{fn.name}`; declare it in static_argnums/"
+                    "static_argnames or pass a device array")
+        for kw in node.keywords:
+            kind = _is_hazard_literal(kw.value)
+            if kind and kw.arg is not None \
+                    and not fn.is_static(None, kw.arg):
+                yield ctx.make_finding(
+                    CODE, kw.value,
+                    f"{kind} passed as traced kwarg `{kw.arg}` of jitted "
+                    f"`{fn.name}`; declare it static or pass a device "
+                    "array")
+
+    # (2) Python branches on traced parameter values inside jitted bodies
+    for fn in jitted.values():
+        if fn.node is None:
+            continue
+        traced = set(fn.params)
+        for i, p in enumerate(fn.params):
+            if fn.is_static(i, p):
+                traced.discard(p)
+        for sub in ast.walk(fn.node):
+            if not isinstance(sub, (ast.If, ast.While)):
+                continue
+            hit = None
+            for nm in ast.walk(sub.test):
+                if isinstance(nm, ast.Name) and nm.id in traced \
+                        and not _static_value_read(ctx, nm):
+                    hit = nm
+                    break
+            if hit is not None:
+                yield ctx.make_finding(
+                    CODE, sub,
+                    f"Python `{'if' if isinstance(sub, ast.If) else 'while'}`"
+                    f" on traced value `{hit.id}` inside jitted "
+                    f"`{fn.name}`: shape-specializes or fails at trace "
+                    "time; use jnp.where/lax.cond or mark the arg static")

@@ -32,7 +32,7 @@ from __future__ import annotations
 
 import functools
 import math
-from typing import Dict, List, NamedTuple, Optional
+from typing import Dict, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -46,7 +46,7 @@ from ..ops.split import (F_DEFAULT_LEFT, F_FEATURE, F_GAIN, F_IS_CAT,
                          F_RIGHT_C, F_RIGHT_G, F_RIGHT_H, F_RIGHT_OUT,
                          F_THRESHOLD, SplitContext)
 from .. import obs
-from ..utils.log import TRAIN_TIMER, log_debug, log_warning
+from ..utils.log import TRAIN_TIMER, log_warning
 from .tree import Tree, categorical_bitsets
 
 
@@ -342,7 +342,7 @@ class SerialTreeLearner:
             info = leaves[leaf]
             if info.best is None:
                 continue
-            gain = float(info.best[0][F_GAIN])
+            gain = info.best[0][F_GAIN]
             if gain > best_gain:
                 best_leaf, best_rec, best_gain = leaf, info.best, gain
         TRAIN_TIMER.stop("fetch")

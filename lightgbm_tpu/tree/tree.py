@@ -10,7 +10,7 @@ text serialization field set are kept byte-compatible with the reference's
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 import numpy as np
 

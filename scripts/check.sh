@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# Single CI entrypoint: lint (ruff), static analysis (jaxlint against the
+# committed baseline), telemetry-validator self-test, docs freshness, and
+# the tier-1 pytest command from ROADMAP.md.  Runs every gate even after
+# a failure so one run reports everything; exits nonzero if ANY failed.
+#
+# Usage: scripts/check.sh [--fast]   (--fast skips the tier-1 pytest run)
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+fail=0
+declare -a results=()
+
+step() {
+    local name="$1"; shift
+    echo "==> ${name}"
+    if "$@"; then
+        results+=("PASS  ${name}")
+    else
+        results+=("FAIL  ${name}")
+        fail=1
+    fi
+    echo
+}
+
+# 1. ruff (pyproject [tool.ruff]); optional: the pinned CI image ships it,
+#    dev boxes without it skip with a warning rather than a false failure
+if command -v ruff >/dev/null 2>&1; then
+    step "ruff" ruff check .
+else
+    echo "==> ruff: not installed, SKIPPED (pip install ruff)"
+    results+=("SKIP  ruff (not installed)")
+    echo
+fi
+
+# 2. jaxlint: new findings (not in jaxlint_baseline.json) fail the build
+step "jaxlint" python -m lightgbm_tpu.tools.jaxlint lightgbm_tpu \
+    --baseline jaxlint_baseline.json
+
+# 3. the telemetry schema validator validates itself
+step "validate_metrics --self-test" \
+    python scripts/validate_metrics.py --self-test
+
+# 4. docs/Parameters.md regenerates identically from the param schema
+step "docs freshness" python scripts/check_docs_params.py
+
+# 5. tier-1 tests (ROADMAP.md command)
+if [[ "${1:-}" != "--fast" ]]; then
+    tier1() {
+        rm -f /tmp/_t1.log
+        timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ \
+            -q -m 'not slow' --continue-on-collection-errors \
+            -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
+            | tee /tmp/_t1.log
+        local rc=${PIPESTATUS[0]}
+        echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' \
+            /tmp/_t1.log | tr -cd . | wc -c)"
+        return "$rc"
+    }
+    step "tier-1 pytest" tier1
+fi
+
+echo "=================================================="
+for r in "${results[@]}"; do echo "$r"; done
+exit "$fail"

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Docs-freshness gate: ``docs/Parameters.md`` must match the schema.
+
+Regenerates the parameter docs from ``lightgbm_tpu.params.PARAM_SCHEMA``
+via :mod:`lightgbm_tpu.utils.gen_docs` and fails when the committed file
+differs — the schema is the single source of truth, so a param change
+without a doc regen is a CI error, not a silent drift.
+
+Usage::
+
+    python scripts/check_docs_params.py          # check, exit 1 on drift
+    python scripts/check_docs_params.py --write  # regenerate in place
+
+Run from ``scripts/check.sh`` and ``tests/test_checks.py``.
+"""
+
+from __future__ import annotations
+
+import difflib
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+DOC = REPO / "docs" / "Parameters.md"
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    sys.path.insert(0, str(REPO))
+    from lightgbm_tpu.utils.gen_docs import render
+
+    fresh = render()
+    if "--write" in argv:
+        DOC.write_text(fresh)
+        print(f"wrote {DOC}")
+        return 0
+
+    committed = DOC.read_text() if DOC.exists() else ""
+    if committed == fresh:
+        print(f"OK: {DOC} matches the parameter schema")
+        return 0
+
+    diff = list(difflib.unified_diff(
+        committed.splitlines(keepends=True), fresh.splitlines(keepends=True),
+        fromfile="docs/Parameters.md (committed)",
+        tofile="docs/Parameters.md (regenerated)", n=2))
+    sys.stderr.writelines(diff[:80])
+    print(f"STALE: docs/Parameters.md is out of date with "
+          f"lightgbm_tpu/params.py ({len(diff)} diff lines); regenerate "
+          f"with `python scripts/check_docs_params.py --write`",
+          file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

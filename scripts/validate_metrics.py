@@ -7,6 +7,11 @@ otherwise.  Also importable: ``validate(doc) -> list[str]`` (empty ==
 valid).  ``tests/test_obs.py`` runs this against a live 2-iteration
 ``bench.py --metrics`` run so tier-1 exercises the enabled path end to
 end.
+
+``python scripts/validate_metrics.py --self-test`` checks the checker:
+a synthetic known-good document must validate clean and each of a set
+of planted schema violations must be caught (run from
+``scripts/check.sh`` so CI notices when the validator itself rots).
 """
 
 from __future__ import annotations
@@ -142,8 +147,97 @@ def validate_training_run(doc: Dict) -> List[str]:
     return errors
 
 
+def _good_doc() -> Dict:
+    """A minimal document that satisfies both ``validate`` and
+    ``validate_training_run``."""
+    return {
+        "schema": SCHEMA_NAME, "schema_version": SCHEMA_VERSION,
+        "created_unix": 1700000000.0, "snapshot_unix": 1700000001.0,
+        "enabled": True,
+        "counters": {"jit.compiles_total": 2},
+        "gauges": {"device.bytes_in_use": 1024},
+        "timings": {"train.iter": {"count": 2, "total_s": 0.5,
+                                   "mean_s": 0.25, "p50_s": 0.2,
+                                   "p95_s": 0.3, "max_s": 0.3}},
+        "jit": {"grow": {"compiles": 2,
+                         "signatures": {"f32[8,16]": 1, "f32[8,32]": 1}}},
+        "device_memory": {"bytes_in_use": 1024,
+                          "peak_bytes_in_use": 4096},
+        "events": {"recorded": 10, "dropped": 0},
+    }
+
+
+def _mutate(doc: Dict, path, value) -> Dict:
+    out = json.loads(json.dumps(doc))
+    cur = out
+    for k in path[:-1]:
+        cur = cur[k]
+    if value is _DELETE:
+        del cur[path[-1]]
+    else:
+        cur[path[-1]] = value
+    return out
+
+
+_DELETE = object()
+
+#: (description, mutation path, bad value, substring the error must carry)
+_SELF_TEST_CASES = [
+    ("wrong schema name", ("schema",), "other", "schema"),
+    ("wrong schema version", ("schema_version",), 99, "schema_version"),
+    ("missing enabled flag", ("enabled",), _DELETE, "enabled"),
+    ("negative counter", ("counters", "jit.compiles_total"), -1,
+     "non-negative"),
+    ("boolean counter", ("counters", "jit.compiles_total"), True,
+     "non-negative"),
+    ("non-numeric gauge", ("gauges", "device.bytes_in_use"), "big",
+     "gauge"),
+    ("timing missing p95", ("timings", "train.iter", "p95_s"), _DELETE,
+     "p95_s"),
+    ("timing p50 > p95", ("timings", "train.iter", "p50_s"), 10.0,
+     "p50 > p95"),
+    ("timing total < max", ("timings", "train.iter", "total_s"), 0.01,
+     "total < max"),
+    ("jit signature count mismatch",
+     ("jit", "grow", "signatures"), {"f32[8,16]": 5}, "compiles"),
+    ("device_memory key dropped", ("device_memory",), _DELETE,
+     "device_memory"),
+    ("negative dropped events", ("events", "dropped"), -2, "events"),
+]
+
+
+def self_test() -> int:
+    good = _good_doc()
+    failures: List[str] = []
+    errs = validate_training_run(good)
+    if errs:
+        failures.append(f"good document rejected: {errs}")
+    for desc, path, value, needle in _SELF_TEST_CASES:
+        errs = validate(_mutate(good, path, value))
+        if not errs:
+            failures.append(f"planted defect not caught: {desc}")
+        elif not any(needle in e for e in errs):
+            failures.append(
+                f"planted defect {desc!r} caught with unexpected "
+                f"message(s): {errs}")
+    disabled = dict(_good_doc(), enabled=False)
+    if "telemetry enabled" not in " ".join(
+            validate_training_run(disabled)):
+        failures.append("disabled run not rejected by "
+                        "validate_training_run")
+    if failures:
+        for f in failures:
+            print(f"SELF-TEST FAIL: {f}", file=sys.stderr)
+        return 1
+    print(f"OK: validator self-test passed "
+          f"({len(_SELF_TEST_CASES) + 2} cases)")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    if argv == ["--self-test"]:
+        return self_test()
     if len(argv) != 1:
         print(__doc__, file=sys.stderr)
         return 2

@@ -1,0 +1,14 @@
+"""JL004 fixture: float64 flowing into device code under disabled x64."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+def make_scores(n):
+    a = jnp.zeros(n, dtype=np.float64)  # PLANT: JL004
+    b = jnp.asarray(np.arange(n), "float64")  # PLANT: JL004
+    c = jnp.float64(3.14)  # PLANT: JL004
+    host = np.asarray([1.0, 2.0], np.float64)   # host-side f64: clean
+    d = jnp.asarray(host, jnp.float32)          # explicit 32-bit: clean
+    return a, b, c, d

@@ -1,0 +1,37 @@
+"""JL006 fixture: module-level state mutated with and without a lock —
+the pattern obs/registry.py solved with a per-registry lock."""
+
+import threading
+
+_REGISTRY = {}
+_EVENTS = []
+_LOCK = threading.Lock()
+_next_id = 0
+
+
+def register(name, value):
+    _REGISTRY[name] = value  # PLANT: JL006
+
+
+def record(evt):
+    _EVENTS.append(evt)  # PLANT: JL006
+
+
+def bump():
+    global _next_id
+    _next_id += 1  # PLANT: JL006
+    return _next_id
+
+
+def register_safe(name, value):
+    with _LOCK:
+        _REGISTRY[name] = value
+
+
+def record_safe(evt):
+    with _LOCK:
+        _EVENTS.append(evt)
+
+
+def read_only(name):
+    return _REGISTRY.get(name)

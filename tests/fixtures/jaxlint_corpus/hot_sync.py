@@ -1,0 +1,67 @@
+"""JL001 fixture: host-device syncs planted inside hot-path loops.
+
+Each ``# PLANT: JLxxx`` marks one defect the analyzer must report
+exactly once; unmarked code is clean by construction and must stay
+silent (tests/test_jaxlint.py enforces both directions).
+"""
+# jaxlint: hot-path
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def per_iteration_item(trees):
+    total = 0.0
+    for t in trees:
+        total += t.value.item()  # PLANT: JL001
+    return total
+
+
+def float_of_device_value(n):
+    scores = jnp.zeros((n,))
+    out = []
+    for i in range(n):
+        out.append(float(scores[i]))  # PLANT: JL001
+    return out
+
+
+def int_of_asarray(handles):
+    count = 0
+    for h in handles:
+        count += int(np.asarray(h))  # PLANT: JL001
+    return count
+
+
+def scalar_subscript_in_while(tree):
+    s = 0.0
+    while s < 10.0:
+        s += float(tree.leaf_value[0])  # PLANT: JL001
+    return s
+
+
+def asarray_per_iteration(n):
+    x = jnp.ones((4,))
+    rows = []
+    for _ in range(n):
+        rows.append(np.asarray(x))  # PLANT: JL001
+    return rows
+
+
+def comprehension_sync(handles):
+    x = jnp.ones((4,))
+    return [int(np.asarray(x)[i]) for i, _ in enumerate(handles)]  # PLANT: JL001
+
+
+def batched_fetch_is_clean(handles):
+    host = jax.device_get(handles)   # one batched transfer, outside loops
+    return [int(v) for v in host]
+
+
+def shape_reads_are_clean(n):
+    x = jnp.ones((n, 4))
+    dims = []
+    for _ in range(3):
+        dims.append(int(x.shape[0]))   # metadata read, no transfer
+    return dims

@@ -1,0 +1,47 @@
+"""JL002 fixture: recompile hazards around ``jax.jit``.
+
+The jit decorators carry ``# jaxlint: disable=JL003`` so this file
+isolates JL002 (and doubles as a suppression-mechanics fixture).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit  # jaxlint: disable=JL003
+def _scale(x, factor):
+    return x * factor
+
+
+@functools.partial(jax.jit, static_argnames=("k",))  # jaxlint: disable=JL003
+def _topk_static_is_clean(x, k):
+    if k > x.shape[0]:   # static arg + shape read: no hazard
+        k = x.shape[0]
+    return jnp.sort(x)[-k:]
+
+
+@jax.jit  # jaxlint: disable=JL003
+def _clip(x, lo):
+    if lo > 0:  # PLANT: JL002
+        return jnp.maximum(x, lo)
+    return x
+
+
+@jax.jit  # jaxlint: disable=JL003
+def _optional_is_clean(x, mask):
+    if mask is None:   # `is None` is a trace-time static
+        return x
+    return x * mask
+
+
+def run(x):
+    y = _scale(x, 2.0)  # PLANT: JL002
+    z = jax.jit(lambda a: a + 1)(y)  # PLANT: JL002
+    ok = _scale(x, jnp.asarray(2.0))   # device scalar: clean
+    return y, z, ok
+
+
+def run_params(x):
+    return _scale(x, {"lr": 0.1})  # PLANT: JL002

@@ -1,0 +1,34 @@
+"""JL005 fixture: set iteration order reaching the output —
+the engine.py callback-dedupe bug class fixed by hand in PR 1."""
+
+
+def callback_order(callbacks):
+    deduped = set(callbacks)
+    out = []
+    for cb in deduped:  # PLANT: JL005
+        out.append(cb)
+    return out
+
+
+def feature_list(names):
+    return list({n.lower() for n in names})  # PLANT: JL005
+
+
+def joined(tags):
+    return ",".join(set(tags))  # PLANT: JL005
+
+
+def comprehension_over_set(rows):
+    return [r * 2 for r in {1, 2, 3}]  # PLANT: JL005
+
+
+def sorted_is_clean(tags):
+    return ",".join(sorted(set(tags)))
+
+
+def membership_is_clean(tags, t):
+    return t in set(tags)
+
+
+def reduction_is_clean(vals):
+    return sum(set(vals)), len(set(vals)), max(set(vals))

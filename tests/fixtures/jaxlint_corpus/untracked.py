@@ -1,0 +1,34 @@
+"""JL003 fixture: jitted callables missing obs.track_jit registration.
+
+``obs`` is only referenced, never imported for real — the analyzer is
+purely static.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu import obs
+
+
+@jax.jit  # PLANT: JL003
+def _untracked_square(x):
+    return x * x
+
+
+@functools.partial(jax.jit, static_argnames=("n",))  # PLANT: JL003
+def _untracked_pad(x, n):
+    return jnp.pad(x, (0, n))
+
+
+@jax.jit
+def _tracked_sum(x):
+    return x.sum()
+
+
+_tracked_sum = obs.track_jit("tracked_sum", _tracked_sum)
+
+_inline_tracked = obs.track_jit("inline", jax.jit(lambda x: x - 1.0))
+
+_untracked_assign = jax.jit(lambda x: x + 1.0)  # PLANT: JL003

@@ -3,14 +3,12 @@ Dataset/Booster mechanics, CLI — modelled on the reference's primary suite
 (tests/python_package_test/test_engine.py, test_sklearn.py, test_basic.py;
 SURVEY.md §4).  These layers previously had zero coverage."""
 
-import os
 
 import numpy as np
 import pytest
 
 import lightgbm_tpu as lgb
 from lightgbm_tpu import Booster, Dataset
-from lightgbm_tpu.utils.log import LightGBMError
 
 
 @pytest.fixture(scope="module")

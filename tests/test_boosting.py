@@ -2,7 +2,6 @@
 (modelled on the reference tests/python_package_test/test_engine.py)."""
 
 import numpy as np
-import pytest
 
 from lightgbm_tpu.config import Config
 from lightgbm_tpu.boosting import create_boosting

@@ -8,7 +8,6 @@ tests drive the shim through exactly those entry points.
 """
 
 import numpy as np
-import pytest
 import scipy.sparse as sp
 
 from lightgbm_tpu import c_api as C

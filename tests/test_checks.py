@@ -1,0 +1,56 @@
+"""CI-entrypoint pieces: docs freshness and the metrics-validator
+self-test (scripts/check.sh runs the same gates plus ruff/jaxlint)."""
+
+import importlib.util
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _load(script_name, module_name):
+    spec = importlib.util.spec_from_file_location(
+        module_name, REPO / "scripts" / script_name)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_parameter_docs_are_fresh():
+    mod = _load("check_docs_params.py", "_check_docs_params")
+    assert mod.main([]) == 0, (
+        "docs/Parameters.md is stale; regenerate with "
+        "`python scripts/check_docs_params.py --write`")
+
+
+def test_parameter_docs_check_catches_drift(tmp_path, monkeypatch):
+    mod = _load("check_docs_params.py", "_check_docs_params_drift")
+    doc = tmp_path / "Parameters.md"
+    doc.write_text("# stale\n")
+    monkeypatch.setattr(mod, "DOC", doc)
+    assert mod.main([]) == 1
+    assert mod.main(["--write"]) == 0
+    assert mod.main([]) == 0
+
+
+def test_validate_metrics_self_test():
+    mod = _load("validate_metrics.py", "_validate_metrics")
+    assert mod.self_test() == 0
+    # and via the CLI flag, as check.sh invokes it
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "validate_metrics.py"),
+         "--self-test"], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+
+def test_check_sh_exists_and_is_executable():
+    sh = REPO / "scripts" / "check.sh"
+    assert sh.exists()
+    assert sh.stat().st_mode & 0o111, "scripts/check.sh must be executable"
+    # every gate is wired in (cheap textual pin so a refactor that drops
+    # one fails here rather than silently in CI)
+    text = sh.read_text()
+    for needle in ("ruff", "jaxlint", "--self-test", "check_docs_params",
+                   "pytest"):
+        assert needle in text, f"check.sh lost its {needle} gate"

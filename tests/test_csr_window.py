@@ -10,7 +10,6 @@ repeated retrains stay bounded in time, and predictions flow from CSR."""
 import time
 
 import numpy as np
-import pytest
 import scipy.sparse as sp
 
 from lightgbm_tpu import basic as lgb_basic

@@ -7,7 +7,6 @@ path, falls back when ineligible, and stops on stump stalls.
 """
 
 import numpy as np
-import pytest
 
 from lightgbm_tpu.boosting import create_boosting
 from lightgbm_tpu.config import Config

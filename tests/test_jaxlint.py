@@ -1,0 +1,254 @@
+"""jaxlint: rule firing on the fixture corpus, suppression mechanics,
+baseline round-trips, and the tier-1 gate over ``lightgbm_tpu/``.
+
+The corpus under ``tests/fixtures/jaxlint_corpus/`` marks every planted
+defect with ``# PLANT: JLxxx``; the tests assert the analyzer reports
+exactly those (rule, line) pairs — no misses, no extras — so both rule
+recall and false-positive regressions fail loudly.
+"""
+
+import json
+import re
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from lightgbm_tpu.tools import jaxlint
+from lightgbm_tpu.tools.jaxlint import baseline as jl_baseline
+from lightgbm_tpu.tools.jaxlint.cli import main as jaxlint_main
+
+REPO = Path(__file__).resolve().parents[1]
+PKG = REPO / "lightgbm_tpu"
+CORPUS = REPO / "tests" / "fixtures" / "jaxlint_corpus"
+BASELINE = REPO / "jaxlint_baseline.json"
+PLANT_RE = re.compile(r"#\s*PLANT:\s*(JL\d{3})")
+
+CORPUS_FILES = sorted(CORPUS.glob("*.py"))
+
+
+def planted(path: Path):
+    """[(rule, line)] of the ``# PLANT:`` markers in a corpus file."""
+    out = []
+    for i, line in enumerate(path.read_text().splitlines(), 1):
+        m = PLANT_RE.search(line)
+        if m:
+            out.append((m.group(1), i))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule firing on the corpus
+# ---------------------------------------------------------------------------
+
+def test_corpus_has_plants_for_every_rule():
+    rules = {r for p in CORPUS_FILES for r, _ in planted(p)}
+    assert rules == set(jaxlint.RULES), \
+        f"corpus must exercise every shipped rule; missing " \
+        f"{set(jaxlint.RULES) - rules}"
+
+
+@pytest.mark.parametrize("path", CORPUS_FILES, ids=lambda p: p.stem)
+def test_each_planted_defect_fires_exactly_once(path):
+    res = jaxlint.analyze_paths([str(path)], root=str(REPO))
+    assert not res.errors
+    got = sorted((f.rule, f.line) for f in res.findings)
+    assert got == sorted(planted(path)), \
+        "findings must match the # PLANT markers exactly (rule, line)"
+
+
+def test_empty_baseline_reports_whole_corpus_exactly_once():
+    res = jaxlint.analyze_paths([str(CORPUS)], root=str(REPO))
+    new, stale = jl_baseline.apply(res.findings, {})   # empty baseline
+    got = sorted((Path(f.path).name, f.rule, f.line) for f in new)
+    want = sorted((p.name, rule, line)
+                  for p in CORPUS_FILES for rule, line in planted(p))
+    assert got == want and not stale
+
+
+# ---------------------------------------------------------------------------
+# suppression mechanics
+# ---------------------------------------------------------------------------
+
+SET_LOOP = "def f(x):\n    for v in set(x):  # {}\n        print(v)\n"
+
+
+def _findings_of(src, name="mod.py"):
+    res = jaxlint.analyze_source(src, name)
+    assert not res.errors
+    return res
+
+
+def test_unsuppressed_fixture_fires():
+    res = _findings_of(SET_LOOP.format("no comment"))
+    assert [f.rule for f in res.findings] == ["JL005"]
+
+
+def test_inline_disable_same_line():
+    res = _findings_of(SET_LOOP.format("jaxlint: disable=JL005"))
+    assert not res.findings
+    assert [f.rule for f in res.suppressed] == ["JL005"]
+
+
+def test_inline_disable_wrong_code_does_not_suppress():
+    res = _findings_of(SET_LOOP.format("jaxlint: disable=JL001"))
+    assert [f.rule for f in res.findings] == ["JL005"]
+
+
+def test_inline_disable_all():
+    res = _findings_of(SET_LOOP.format("jaxlint: disable=all"))
+    assert not res.findings and len(res.suppressed) == 1
+
+
+def test_disable_next_line():
+    src = ("def f(x):\n"
+           "    # jaxlint: disable-next=JL005\n"
+           "    for v in set(x):\n"
+           "        print(v)\n")
+    res = _findings_of(src)
+    assert not res.findings and len(res.suppressed) == 1
+
+
+def test_corpus_recompile_file_suppresses_its_jl003():
+    # recompile.py isolates JL002 by suppressing the JL003 findings its
+    # jit decorators would otherwise raise — which also pins down that
+    # same-line suppression works on decorator lines
+    res = jaxlint.analyze_paths([str(CORPUS / "recompile.py")],
+                                root=str(REPO))
+    assert {f.rule for f in res.suppressed} == {"JL003"}
+    assert {f.rule for f in res.findings} == {"JL002"}
+
+
+# ---------------------------------------------------------------------------
+# baseline add/remove round-trip
+# ---------------------------------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    res = jaxlint.analyze_paths([str(CORPUS)], root=str(REPO))
+    bl = tmp_path / "bl.json"
+    jl_baseline.write(str(bl), res.findings)
+
+    loaded = jl_baseline.load(str(bl))
+    assert sum(loaded.values()) == len(res.findings)
+    new, stale = jl_baseline.apply(res.findings, loaded)
+    assert new == [] and stale == []
+
+    # removing one entry re-exposes exactly that finding as new
+    doc = json.loads(bl.read_text())
+    removed = doc["entries"].pop(0)
+    removed_key = (removed["file"], removed["rule"], removed["snippet"])
+    bl.write_text(json.dumps(doc))
+    new, stale = jl_baseline.apply(res.findings, jl_baseline.load(str(bl)))
+    assert len(new) == removed["count"] and not stale
+    assert all(jl_baseline.finding_key(f) == removed_key for f in new)
+
+    # a baseline entry with no surviving finding is reported stale
+    res_none = jaxlint.AnalysisResult()
+    new, stale = jl_baseline.apply(res_none.findings,
+                                   jl_baseline.load(str(bl)))
+    assert not new and sum(n for _, n in stale) == len(res.findings) - \
+        removed["count"]
+
+
+def test_baseline_is_line_number_independent():
+    src = "def f(x):\n    for v in set(x):\n        print(v)\n"
+    res1 = _findings_of(src)
+    # same code shifted two lines down: same baseline key
+    res2 = _findings_of("# pad\n# pad\n" + src)
+    assert res1.findings[0].line != res2.findings[0].line
+    new, _ = jl_baseline.apply(
+        res2.findings, {jl_baseline.finding_key(res1.findings[0]): 1})
+    assert new == []
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: the package is clean against the committed baseline
+# ---------------------------------------------------------------------------
+
+def test_package_clean_against_committed_baseline():
+    accepted = jl_baseline.load(str(BASELINE))
+    res = jaxlint.analyze_paths([str(PKG)], root=str(REPO))
+    assert not res.errors
+    new, _ = jl_baseline.apply(res.findings, accepted)
+    assert not new, (
+        "new jaxlint findings (fix them or regenerate the baseline with "
+        "`python -m lightgbm_tpu.tools.jaxlint lightgbm_tpu "
+        "--write-baseline` and justify in the PR):\n"
+        + "\n".join(f"  {f.path}:{f.line}: {f.rule} {f.message}"
+                    for f in new))
+
+
+def test_analyzer_is_clean_on_itself():
+    res = jaxlint.analyze_paths([str(PKG / "tools")], root=str(REPO))
+    assert not res.errors and not res.findings
+
+
+# ---------------------------------------------------------------------------
+# CLI surface (in-process and the acceptance subprocess path)
+# ---------------------------------------------------------------------------
+
+def test_cli_list_rules(capsys):
+    assert jaxlint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for code in jaxlint.RULES:
+        assert code in out
+
+
+def test_cli_unknown_rule_is_usage_error(capsys):
+    assert jaxlint_main(["--select", "JL999", str(CORPUS)]) == 2
+
+
+def test_cli_json_format(capsys):
+    rc = jaxlint_main([str(CORPUS / "set_order.py"), "--no-baseline",
+                       "--format", "json", "--root", str(REPO)])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["total"] == len(planted(CORPUS / "set_order.py"))
+    assert all(f["rule"] == "JL005" for f in doc["new"])
+
+
+def test_cli_package_with_baseline_exits_zero(capsys):
+    rc = jaxlint_main([str(PKG), "--baseline", str(BASELINE),
+                       "--root", str(REPO)])
+    assert rc == 0, capsys.readouterr().out
+
+
+def test_cli_write_baseline_refuses_select(tmp_path, capsys):
+    # a rule-filtered write would silently erase the other rules'
+    # accepted entries
+    bl = tmp_path / "bl.json"
+    rc = jaxlint_main([str(CORPUS), "--baseline", str(bl), "--select",
+                       "JL001", "--write-baseline", "--root", str(REPO)])
+    assert rc == 2 and not bl.exists()
+
+
+def test_cli_write_baseline_round_trip(tmp_path, capsys):
+    bl = tmp_path / "bl.json"
+    assert jaxlint_main([str(CORPUS), "--baseline", str(bl),
+                         "--write-baseline", "--root", str(REPO)]) == 0
+    assert jaxlint_main([str(CORPUS), "--baseline", str(bl),
+                         "--root", str(REPO)]) == 0
+
+
+def test_cli_injected_defect_fails_package_scan(tmp_path):
+    """Acceptance: copying a known-bad corpus file into the package makes
+    `python -m lightgbm_tpu.tools.jaxlint lightgbm_tpu` exit nonzero
+    against the committed baseline."""
+    shutil.copytree(PKG, tmp_path / "lightgbm_tpu",
+                    ignore=shutil.ignore_patterns("__pycache__"))
+    shutil.copy(BASELINE, tmp_path / "jaxlint_baseline.json")
+    env_cmd = [sys.executable, "-m", "lightgbm_tpu.tools.jaxlint",
+               "lightgbm_tpu"]
+
+    clean = subprocess.run(env_cmd, cwd=tmp_path, capture_output=True,
+                           text=True)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+
+    shutil.copy(CORPUS / "hot_sync.py",
+                tmp_path / "lightgbm_tpu" / "_injected_bad.py")
+    bad = subprocess.run(env_cmd, cwd=tmp_path, capture_output=True,
+                         text=True)
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    assert "_injected_bad.py" in bad.stdout

@@ -2,7 +2,6 @@
 
 import numpy as np
 import jax.numpy as jnp
-import pytest
 
 from lightgbm_tpu.config import Config
 from lightgbm_tpu.data.dataset import BinnedDataset
