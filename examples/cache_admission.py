@@ -51,6 +51,10 @@ TRAIN_PARAMS = ("boosting=gbdt objective=binary max_bin=255 "
                 "min_data_in_leaf=50 min_sum_hessian_in_leaf=5.0 "
                 "verbosity=-1")
 NUM_ITERATIONS = 50
+# iterations fused per device dispatch (LGBM_BoosterUpdateChunked /
+# GBDT.train_chunked).  The fork's bagging_freq=5 + feature_fraction=0.8
+# config is fused-eligible since the draws moved inside the device scan
+TRAIN_CHUNK = 25
 
 
 def synth_trace(n_requests: int, n_objects: int, seed: int = 7):
@@ -205,11 +209,12 @@ class CApiTrainer:
             ds.value, "label", labels, len(labels), C.C_API_DTYPE_FLOAT32))
         bst = C.Ref()
         self._check(C.LGBM_BoosterCreate(ds.value, TRAIN_PARAMS, bst))
-        for _ in range(NUM_ITERATIONS):
-            fin = C.Ref()
-            self._check(C.LGBM_BoosterUpdateOneIter(bst.value, fin))
-            if fin.value:
-                break
+        # one chunked call per window (test.cpp's 50-iteration
+        # UpdateOneIter loop collapses into NUM_ITERATIONS/TRAIN_CHUNK
+        # device dispatches when the fused path is eligible)
+        fin = C.Ref()
+        self._check(C.LGBM_BoosterUpdateChunked(
+            bst.value, NUM_ITERATIONS, TRAIN_CHUNK, fin))
         if self.booster is not None:
             self._check(C.LGBM_BoosterFree(self.booster))
         self.booster = bst.value
@@ -230,7 +235,7 @@ class CApiTrainer:
         return fp / len(labels), fn / len(labels)
 
 
-def main() -> int:
+def build_arg_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--trace", default="synth",
                     help="'synth' or a file of 'seq id size cost' lines")
@@ -250,8 +255,13 @@ def main() -> int:
                     help="write a Chrome-trace/Perfetto timeline of the "
                          "whole windowed session (--trace is taken by "
                          "the input trace file)")
-    args = ap.parse_args()
+    return ap
 
+
+def run(args) -> dict:
+    """Run the windowed harness; returns the summary dict (the JSON
+    line ``main`` prints).  Importable — ``bench.py --suite cache``
+    drives this directly."""
     from lightgbm_tpu import obs
     if args.metrics or args.obs_trace:
         obs.configure(enabled=True, metrics_path=args.metrics or None,
@@ -318,7 +328,7 @@ def main() -> int:
     if obs.enabled():
         obs.flush()
         obs_summary = obs.summary()
-    print(json.dumps({
+    return {
         "metric": "cache_admission_train_s_per_1M_sampled_rows",
         "value": round(train_per_m, 3), "unit": "s",
         "baseline_ref_train_s_per_1M": round(125.4 / 20.0, 3),
@@ -327,9 +337,14 @@ def main() -> int:
                            "125.4 s / 20M-request window)",
         "derive_s_per_1M_requests": round(derive_per_m, 3),
         "ref_derive_s_per_1M": round(94.6 / 20.0, 3),
+        "train_chunk": TRAIN_CHUNK,
         "windows": windows,
         "obs": obs_summary,
-    }))
+    }
+
+
+def main() -> int:
+    print(json.dumps(run(build_arg_parser().parse_args())))
     return 0
 
 

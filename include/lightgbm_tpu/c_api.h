@@ -65,6 +65,16 @@ LIGHTGBM_C_EXPORT int LGBM_BoosterFree(BoosterHandle handle);
 LIGHTGBM_C_EXPORT int LGBM_BoosterUpdateOneIter(BoosterHandle handle,
                                                 int* is_finished);
 
+/* lightgbm_tpu extension (not in the fork's ABI): run num_iters
+ * boosting iterations in fused device dispatches of up to `chunk`
+ * whole iterations each.  Replaces an UpdateOneIter loop with one call
+ * per retrain window so wall-clock tracks device throughput instead of
+ * per-iteration host dispatch latency.  Sets *is_finished to 1 when
+ * training stopped early (no more splittable leaves). */
+LIGHTGBM_C_EXPORT int LGBM_BoosterUpdateChunked(BoosterHandle handle,
+                                                int num_iters, int chunk,
+                                                int* is_finished);
+
 LIGHTGBM_C_EXPORT int LGBM_BoosterGetCurrentIteration(
     BoosterHandle handle, int64_t* out_iteration);
 

@@ -299,14 +299,31 @@ class Booster:
 
     def update(self, train_set=None, fobj=None) -> bool:
         """One boosting iteration; returns True if stopped early
-        (no more splits)."""
+        (no more splits).  Drives ``GBDT.train_chunked`` — a single
+        iteration takes the per-iteration device path, but the unified
+        driver keeps host bagging state consistent when fused chunks
+        (``update_chunked``, ``engine.train``) and single updates mix."""
         if train_set is not None:
             raise LightGBMError(
                 "resetting training data mid-training is not supported yet")
         if fobj is None:
-            return self._gbdt.train_one_iter()
+            return self._gbdt.train_chunked(1)
         grad, hess = fobj(self._curr_pred_for_fobj(), self._train_set)
         return self.__boost(grad, hess)
+
+    def update_chunked(self, n_iters: int, chunk: int = None) -> bool:
+        """Train ``n_iters`` iterations, fusing up to ``chunk`` whole
+        iterations into one device dispatch when the configuration
+        allows (``GBDT.train_chunked``); returns True if training
+        stopped early.  ``chunk`` defaults to the booster's
+        ``fused_chunk`` param (so ``fused_chunk<=1`` disables fusing
+        here too, like every other driver).  Callback/eval cadence does
+        not apply here — use ``engine.train`` when per-iteration hooks
+        are needed."""
+        if chunk is None:
+            chunk = max(int(getattr(self._gbdt.config, "fused_chunk",
+                                    20)), 0)
+        return self._gbdt.train_chunked(n_iters, chunk=chunk)
 
     def _curr_pred_for_fobj(self):
         score = np.asarray(self._gbdt.train_score, np.float64)

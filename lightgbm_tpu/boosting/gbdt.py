@@ -492,9 +492,12 @@ class GBDT:
                             self.train_score.at[k].add(output)
                 self.models.append(tree)
                 continue
-            # fresh feature_fraction draw per tree, like the host
-            # learner's per-train sampling (learner.py:248)
-            mask = self.learner._feature_mask()
+            # fresh feature_fraction draw per tree, fold_in-keyed by the
+            # global tree index so the fused scan draws the SAME masks
+            # (grow.feature_fraction_mask; the host learner keeps its
+            # own numpy stream)
+            mask = self._grower.feature_mask_for(
+                self.iter * self.num_model + k)
             score, rec_i, rec_f, rec_c, nl, root_val, waves = \
                 self._grower.grow_one_iter(
                     self.train_score[k], grad[k], hess[k], mask, shrink,
@@ -529,12 +532,14 @@ class GBDT:
     def _fused_grad_fn(self):
         """(grad_fn, gargs) when fused multi-iteration training is sound
         for the CURRENT state, else None.  Sound means: plain GBDT (no
-        DART/GOSS/RF overrides), single model, no bagging, full
-        feature_fraction, and an objective exposing a pure device
-        gradient."""
+        DART/GOSS/RF overrides), single model, and an objective exposing
+        a pure device gradient.  Bagging and feature_fraction no longer
+        disqualify: their draws moved inside the fused scan
+        (DeviceGrower.fused_train), which is what lets the fork
+        harness's exact config (feature_fraction=0.8, bagging_freq=5)
+        use the fastest path."""
         if (self._grower is None or type(self) is not GBDT
-                or self.num_model != 1 or self.need_bagging
-                or self.config.feature_fraction < 1.0
+                or self.num_model != 1
                 or self.train_set.num_features == 0
                 or self.objective is None
                 or not self.class_need_train[0]):
@@ -563,6 +568,10 @@ class GBDT:
         iterations exactly as before.
         """
         fg = self._fused_grad_fn()
+        # a request smaller than the chunk still deserves ONE fused
+        # dispatch of its own length (otherwise update_chunked(15) with
+        # the default chunk=20 would silently run fully per-iteration)
+        chunk = min(chunk, n_iters)
         if fg is None or chunk <= 1:
             for _ in range(n_iters):
                 if self.train_one_iter():
@@ -571,8 +580,8 @@ class GBDT:
         grad_fn, gargs = fg
         lr = jnp.asarray(self.shrinkage_rate * self._tree_multiplier(),
                          jnp.float32)
-        mask = self.learner._feature_mask()   # all ones (ff == 1.0)
         done = 0
+        fused_ran = False
         while done < n_iters:
             if self._device_stop:
                 return True
@@ -580,6 +589,8 @@ class GBDT:
             if k < chunk:
                 # remainder: per-iteration path (a second scan length
                 # would cost a fresh XLA compile of the whole program)
+                if fused_ran:
+                    self._sync_fused_bagging()
                 for _ in range(k):
                     if self.train_one_iter():
                         return True
@@ -589,7 +600,8 @@ class GBDT:
             t0 = time.perf_counter() if obs.enabled() else None
             score, (rec_i, rec_f, rec_c, nl, _root, waves) = fused(
                 self._grower.binned, self._grower.binned_t,
-                self.train_score[0], mask, lr, gargs, grad_fn=grad_fn)
+                self.train_score[0], lr, gargs,
+                jnp.asarray(self.iter, jnp.int32), grad_fn=grad_fn)
             if t0 is not None:
                 self._obs_chunk(t0, chunk, score)
             self.train_score = self.train_score.at[0].set(score)
@@ -601,6 +613,7 @@ class GBDT:
             self._wave_handles.append(waves)
             self.iter += chunk
             done += chunk
+            fused_ran = True
             # lagged stall check: the PREVIOUS chunk's records have
             # landed by now (this chunk is seconds of device work), so
             # reading them never blocks the dispatch pipeline
@@ -608,7 +621,30 @@ class GBDT:
             if prev is not None and (prev.host()[3] <= 1).all():
                 self._trim_device_stumps()
                 return True
+        if fused_ran:
+            self._sync_fused_bagging()
         return False
+
+    def _sync_fused_bagging(self):
+        """Restore the host-side bagging state to what a pure
+        per-iteration run would hold at ``self.iter``: fused chunks draw
+        their row masks inside the scan without touching
+        ``bag_buffer``, so a later per-iteration step (chunk remainder,
+        ``Booster.update``, ``rollback_one_iter``'s traversal) must
+        first re-materialize the draw of the last redraw boundary to
+        continue bit-identically."""
+        if not self.need_bagging or self.iter <= 0:
+            return
+        # the draw active after iteration (self.iter - 1) — NOT
+        # self.iter's own boundary: when self.iter is itself a redraw
+        # multiple, the per-iteration path still holds the previous
+        # boundary's mask until bagging(self.iter) runs, and a
+        # rollback_one_iter + update continues from that one
+        last_done = self.iter - 1
+        it_last = last_done - last_done % self.bag_freq
+        seed = (self.config.bagging_seed + it_last) & 0x7FFFFFFF
+        self.bag_buffer, self.bag_count = self.learner.bagging_state(
+            seed, self.bag_fraction)
 
     def _obs_chunk(self, t0, chunk, score):
         """Record one fused multi-iteration dispatch: a ``train.chunk``
@@ -621,6 +657,8 @@ class GBDT:
             jax.block_until_ready(score)
         dt = time.perf_counter() - t0
         STATE.registry.observe("train.chunk", dt)
+        STATE.registry.inc("train.fused_chunks")
+        STATE.registry.set_gauge("train.fused_chunk_len", chunk)
         STATE.trace.add("train.chunk", cat="boost", t0=t0, dur=dt,
                         args={"iteration": self.iter, "chunk": chunk})
         for _ in range(chunk):

@@ -352,7 +352,22 @@ def LGBM_BoosterGetNumClasses(handle, out_len: Ref):
 @_api
 def LGBM_BoosterUpdateOneIter(handle, is_finished: Ref):
     b = _get(handle, _BoosterEntry)
-    is_finished.value = 1 if b.gbdt.train_one_iter() else 0
+    # unified driver: a 1-iteration chunk takes the per-iteration device
+    # path but keeps bagging state consistent with fused chunks
+    is_finished.value = 1 if b.gbdt.train_chunked(1) else 0
+
+
+@_api
+def LGBM_BoosterUpdateChunked(handle, n_iters, chunk, is_finished: Ref):
+    """lightgbm_tpu extension (not in the reference ABI): train
+    ``n_iters`` boosting iterations in fused device dispatches of up to
+    ``chunk`` whole iterations each (``GBDT.train_chunked``).  The
+    windowed retrain harness replaces its UpdateOneIter loop with ONE
+    call per window, which is what lets wall-clock track device
+    throughput instead of per-iteration host dispatch latency."""
+    b = _get(handle, _BoosterEntry)
+    is_finished.value = 1 if b.gbdt.train_chunked(int(n_iters),
+                                                  chunk=int(chunk)) else 0
 
 
 @_api

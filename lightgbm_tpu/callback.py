@@ -38,6 +38,9 @@ def print_evaluation(period=1, show_stdv=True):
                                for x in env.evaluation_result_list)
             log_info(f"[{env.iteration + 1}]\t{result}")
     _callback.order = 10
+    # acts only on iterations that carry evaluation results, so the
+    # fused driver may skip its empty-list invocations (engine.train)
+    _callback.eval_cadence_only = True
     return _callback
 
 
@@ -59,6 +62,7 @@ def record_evaluation(eval_result):
             eval_result[data_name].setdefault(eval_name, [])
             eval_result[data_name][eval_name].append(result)
     _callback.order = 20
+    _callback.eval_cadence_only = True
     return _callback
 
 
@@ -116,6 +120,18 @@ def early_stopping(stopping_rounds, first_metric_only=False, verbose=True):
 
     def _callback(env):
         if not best_score:
+            mf = max(int(env.params.get("metric_freq", 1) or 1), 1)
+            if (not env.evaluation_result_list
+                    and (env.iteration + 1) % mf != 0
+                    and env.iteration != env.end_iteration - 1):
+                # evaluation was SKIPPED this iteration (metric_freq>1,
+                # off-cadence): defer init to the first eval-carrying
+                # invocation so fused and per-iteration driving behave
+                # identically.  An empty list ON an eval-cadence
+                # iteration means no eval data is configured at all —
+                # _init raises its configuration error immediately,
+                # before device time is wasted
+                return
             _init(env)
         if not enabled[0]:
             return
@@ -143,4 +159,9 @@ def early_stopping(stopping_rounds, first_metric_only=False, verbose=True):
             if first_metric_only:
                 break
     _callback.order = 30
+    _callback.eval_cadence_only = True
+    # engine.train refuses to fuse when this callback is present with no
+    # eval data configured, so _init's configuration error still fires
+    # on the FIRST iteration, not after a full fused run
+    _callback.requires_eval = True
     return _callback

@@ -96,6 +96,15 @@ def booster_update_one_iter(handle):
     return int(fin.value)
 
 
+def booster_update_chunked(handle, n_iters, chunk):
+    fin = C.Ref()
+    with obs.span("capi.update_chunked", cat="capi",
+                  n_iters=int(n_iters), chunk=int(chunk)):
+        _call(C.LGBM_BoosterUpdateChunked, handle, int(n_iters),
+              int(chunk), fin)
+    return int(fin.value)
+
+
 def booster_calc_num_predict(handle, num_row, predict_type,
                              num_iteration):
     out = C.Ref()

@@ -17,6 +17,14 @@ from .utils.log import LightGBMError, log_warning
 __all__ = ["train", "cv"]
 
 
+def steps_to_boundary(i: int, freq: int) -> int:
+    """Iterations to run, starting at ``i``, to land on (and include)
+    the next iteration j >= i with ``(j + 1) % freq == 0`` — the shared
+    chunk cap that keeps fused driving's metric/snapshot cadence
+    byte-identical to the per-iteration loop (also used by cli.py)."""
+    return ((freq - ((i + 1) % freq)) % freq) + 1
+
+
 def _dedupe_callbacks(callbacks) -> List:
     """Explicit ordered dedupe of user callbacks (identity/equality based,
     first occurrence wins) — replaces the old ``set()`` which iterated in
@@ -103,17 +111,58 @@ def train(params, train_set, num_boost_round=100, valid_sets=None,
     cbs_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
     metric_freq = int(params.get("metric_freq", 1) or 1)
-    for i in range(init_iter, init_iter + num_boost_round):
+    end_iter = init_iter + num_boost_round
+    # fused driving: when every callback is either pure telemetry or
+    # only acts on eval-carrying iterations, whole stretches between
+    # evaluation boundaries run as ONE device dispatch
+    # (GBDT.train_chunked).  Any opaque user callback (or a
+    # before-iteration one like reset_parameter) forces the
+    # per-iteration loop — its CallbackEnv cadence is the contract.
+    fused_cap = max(int(getattr(booster._gbdt.config, "fused_chunk",
+                                20)), 0)
+    cbs_opaque = any(
+        not (getattr(cb, "eval_cadence_only", False)
+             or getattr(cb, "obs_hook", False))
+        for cb in cbs_before + cbs_after)
+    has_eval = (bool(booster.name_valid_sets) or is_valid_contain_train
+                or feval is not None)
+    # an eval-requiring callback (early_stopping) with no eval data is a
+    # misconfiguration; stay per-iteration so its error fires at
+    # iteration 0 instead of after a whole fused run
+    needs_eval_cb = any(getattr(cb, "requires_eval", False)
+                        for cb in cbs_before + cbs_after)
+    can_fuse = (fobj is None and fused_cap > 1 and not cbs_opaque
+                and not (needs_eval_cb and not has_eval)
+                and booster._gbdt.fused_eligible())
+
+    evaluation_result_list = []
+    i = init_iter
+    while i < end_iter:
         for cb in cbs_before:
             cb(callback_mod.CallbackEnv(
                 model=booster, params=params, iteration=i,
                 begin_iteration=init_iter,
-                end_iteration=init_iter + num_boost_round,
+                end_iteration=end_iter,
                 evaluation_result_list=None))
-        finished = booster.update(fobj=fobj)
+        step = 1
+        if can_fuse:
+            step = end_iter - i
+            if has_eval:
+                # up to and including the next iteration whose results
+                # feed callbacks — eval cadence is preserved exactly
+                step = min(step, steps_to_boundary(i, metric_freq))
+        if step > 1:
+            before_it = booster._gbdt.iter
+            finished = booster._gbdt.train_chunked(
+                step, chunk=min(step, fused_cap))
+            advanced = max(booster._gbdt.iter - before_it, 1)
+        else:
+            finished = booster.update(fobj=fobj)
+            advanced = 1
+        i_done = i + advanced - 1
 
         evaluation_result_list = []
-        if (i + 1) % metric_freq == 0 or i == init_iter + num_boost_round - 1:
+        if (i_done + 1) % metric_freq == 0 or i_done == end_iter - 1:
             if is_valid_contain_train:
                 evaluation_result_list.extend(
                     [(train_data_name, n, v, b)
@@ -122,14 +171,15 @@ def train(params, train_set, num_boost_round=100, valid_sets=None,
         try:
             for cb in cbs_after:
                 cb(callback_mod.CallbackEnv(
-                    model=booster, params=params, iteration=i,
+                    model=booster, params=params, iteration=i_done,
                     begin_iteration=init_iter,
-                    end_iteration=init_iter + num_boost_round,
+                    end_iteration=end_iter,
                     evaluation_result_list=evaluation_result_list))
         except callback_mod.EarlyStopException as es:
             booster.best_iteration = es.best_iteration + 1
             evaluation_result_list = es.best_score
             break
+        i += advanced
         if finished:
             break
 

@@ -388,6 +388,9 @@ def iteration_hooks() -> Tuple:
             state["t0"] = time.perf_counter()
     _before.before_iteration = True
     _before.order = -1000
+    # pure telemetry: the fused engine driver may invoke the pair once
+    # per chunk instead of once per iteration (engine.train)
+    _before.obs_hook = True
 
     def _after(env):
         t0 = state.pop("t0", None)
@@ -402,6 +405,7 @@ def iteration_hooks() -> Tuple:
                     iteration=env.iteration, value=float(rec[2]))
         sample_device_memory()
     _after.order = 1000
+    _after.obs_hook = True
 
     return _before, _after
 

@@ -22,15 +22,38 @@ def bagging_partition(key, n_pad: int, num_data, fraction):
                          jnp.asarray(fraction, jnp.float32))
 
 
-@functools.partial(jax.jit, static_argnames=("n_pad",))
-def _bagging_impl(key, n_pad, num_data, fraction):
+def _bag_selection(key, n_pad: int, num_data, fraction):
+    """The ONE Bernoulli selection draw both bagging representations
+    share: (valid, selected) bool (n_pad,) vectors.  Keeping it single-
+    sourced is what guarantees the fused scan's row mask and the
+    per-iteration permutation buffer select bit-identical bags."""
     pos = jnp.arange(n_pad, dtype=jnp.int32)
     valid = pos < num_data
     u = jax.random.uniform(key, (n_pad,))
-    selected = valid & (u < fraction)
+    return valid, valid & (u < fraction)
+
+
+@functools.partial(jax.jit, static_argnames=("n_pad",))
+def _bagging_impl(key, n_pad, num_data, fraction):
+    valid, selected = _bag_selection(key, n_pad, num_data, fraction)
     sort_key = jnp.where(selected, 0, jnp.where(valid, 1, 2))
     order = jnp.argsort(sort_key.astype(jnp.int32), stable=True)
     return order.astype(jnp.int32), selected.sum().astype(jnp.int32)
+
+
+def bagging_row_mask(seed, n_pad: int, num_data: int, fraction):
+    """(num_data,) f32 0/1 in-bag indicator from the SAME uniform draw
+    ``bagging_partition`` makes for ``(PRNGKey(seed), n_pad)``.
+
+    ``n_pad`` must be the learner's bagging-buffer pad (``bucket_size``),
+    not the grower's chunk pad: the uniform draw's shape is part of the
+    stream, so mask-based (fused scan) and buffer-based (per-iteration)
+    bagging only agree bit-for-bit when both draw ``(n_pad,)`` uniforms.
+    Traceable — ``seed`` may be a scan-carried iteration index.
+    """
+    _, sel = _bag_selection(jax.random.PRNGKey(seed), n_pad, num_data,
+                            fraction)
+    return sel.astype(jnp.float32)[:num_data]
 
 
 @functools.partial(jax.jit, static_argnames=("n_pad",))

@@ -80,6 +80,19 @@ def _ceil_to(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def feature_fraction_mask(seed: int, tree_idx, nf: int, k: int):
+    """(nf,) bool mask selecting ``k`` features without replacement:
+    ``fold_in(PRNGKey(seed), tree_idx)`` then the k smallest of nf
+    uniforms.  Shared by the per-iteration device path and the fused
+    scan (``tree_idx`` may be traced) so both draw bit-identical masks
+    for the same global tree index — the property the fused-parity
+    tests pin."""
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), tree_idx)
+    u = jax.random.uniform(key, (nf,))
+    thr = jnp.sort(u)[k - 1]
+    return u <= thr
+
+
 def _combine_hist_cols(h, k: int):
     """Collapse the K accumulated stat columns (last axis) to [g, h, cnt].
     K=3: passthrough.  K=4: striped counts summed.  K=5: hi/lo g,h.
@@ -212,6 +225,32 @@ class DeviceGrower:
             "grow_masked", jax.jit(functools.partial(self._grow_impl,
                                                      with_mask=True)))
         self._fused = {}   # scan length -> jitted multi-iteration program
+        # sampling state for device-side draws (feature_fraction masks,
+        # fused bagging): seeds mirror the host learner's derivation
+        # (learner.py _rng / GBDT.bagging) so fused and per-iteration
+        # paths stay bit-identical
+        self._ff_frac = float(config.feature_fraction)
+        nf = int(dataset.num_features)
+        self._ff_nf = nf
+        self._ff_k = max(1, int(np.ceil(nf * self._ff_frac)))
+        self._ff_seed = int(config.feature_fraction_seed
+                            if config.feature_fraction_seed
+                            else config.seed + 2) & 0x7FFFFFFF
+        self._bag_fraction = float(config.bagging_fraction)
+        self._bag_freq = int(config.bagging_freq)
+        self._bag_seed = int(config.bagging_seed) & 0x7FFFFFFF
+        from .histogram import bucket_size
+        self._bag_npad = bucket_size(max(self.num_data, 1))
+
+    # ------------------------------------------------------------------
+    def feature_mask_for(self, tree_idx):
+        """Deterministic per-tree feature_fraction mask (device array).
+        ``tree_idx`` is the global tree index (iter * num_model + k);
+        accepts traced values inside the fused scan."""
+        if self._ff_frac >= 1.0 or self._ff_nf <= 1:
+            return jnp.ones(self._ff_nf, dtype=bool)
+        return feature_fraction_mask(self._ff_seed, tree_idx,
+                                     self._ff_nf, self._ff_k)
 
     # ------------------------------------------------------------------
     # wave histogram: one dense pass for up to W pending leaves
@@ -649,34 +688,70 @@ class DeviceGrower:
         iterations amortizes every host touch 1/K and makes wall-clock
         track device throughput.
 
+        Sampling lives INSIDE the scan: the per-tree feature_fraction
+        mask is ``fold_in(key, tree_idx)`` and the bagging row mask is
+        re-drawn every ``bagging_freq`` trees with the per-iteration
+        path's exact ``(bagging_seed + it)`` seeding, so the fork
+        harness's ``feature_fraction=0.8, bagging_freq=5`` config fuses
+        and still emits bit-identical trees (tests/test_fused.py).
+
         Signature of the returned program::
 
-            run(binned, binned_t, score, feature_mask, lr, gargs,
-                grad_fn=fn)
+            run(binned, binned_t, score, lr, gargs, it0, grad_fn=fn)
             -> (final_score,
                 (rec_i (K,L-1,5), rec_f (K,L-1,9), rec_c (K,L-1,8),
                  nl (K,), root_value (K,), waves (K,)))
 
+        ``it0`` is the global iteration index of the chunk's first tree
+        (traced, so resuming mid-run reuses the compiled program).
         ``grad_fn(score, gargs) -> (grad, hess)`` comes from
         ``ObjectiveFunction.device_grad`` (pure jnp; all arrays via
         ``gargs``).  Compiled once per (length, grad_fn) pair — callers
         must reuse one grad_fn instance to hit the jit cache.
         """
         if length not in self._fused:
-            def run(binned, binned_t, score, feature_mask, lr, gargs,
-                    grad_fn):
-                no_mask = jnp.zeros((0,), jnp.float32)
+            use_bag = self._bag_fraction < 1.0 and self._bag_freq > 0
+            bag_freq, bag_seed = self._bag_freq, self._bag_seed
+            bag_frac, bag_npad = self._bag_fraction, self._bag_npad
 
-                def body(sc, _):
+            def run(binned, binned_t, score, lr, gargs, it0, grad_fn):
+                no_mask = jnp.zeros((0,), jnp.float32)
+                its = jnp.arange(length, dtype=jnp.int32) + it0
+
+                def draw_bag(it):
+                    from .bagging import bagging_row_mask
+                    return bagging_row_mask(
+                        (bag_seed + it) & 0x7FFFFFFF, bag_npad,
+                        self.num_data, bag_frac)
+
+                def body(carry, it):
+                    sc, bmask = (carry if use_bag else (carry, None))
                     g, h = grad_fn(sc, gargs)
+                    fmask = self.feature_mask_for(it)
+                    if use_bag:
+                        # cond, not where: only redraw steps pay the
+                        # (bag_npad,) uniform generation
+                        bmask = jax.lax.cond(it % bag_freq == 0,
+                                             lambda: draw_bag(it),
+                                             lambda: bmask)
                     (new_score, rec_i, rec_f, rec_c, nl, root, waves) = \
                         self._grow_impl(binned, binned_t, sc, g, h,
-                                        feature_mask, lr, no_mask,
-                                        with_mask=False)
-                    return new_score, (rec_i, rec_f, rec_c, nl, root,
-                                       waves)
+                                        fmask, lr,
+                                        bmask if use_bag else no_mask,
+                                        with_mask=use_bag)
+                    out = (rec_i, rec_f, rec_c, nl, root, waves)
+                    return ((new_score, bmask) if use_bag
+                            else new_score), out
 
-                return jax.lax.scan(body, score, None, length=length)
+                if use_bag:
+                    # carry init: the mask active at it0 — drawn at the
+                    # last redraw boundary; when it0 itself is a boundary
+                    # the first step re-draws the same seed (no-op)
+                    init = (score, draw_bag(it0 - it0 % bag_freq))
+                    (final_score, _), recs = jax.lax.scan(
+                        body, init, its)
+                    return final_score, recs
+                return jax.lax.scan(body, score, its)
 
             self._fused[length] = obs.track_jit(
                 "fused_train", jax.jit(run, static_argnames=("grad_fn",)),

@@ -397,9 +397,18 @@ PARAM_SCHEMA: Sequence[Param] = (
        desc="fully on-device wave-synchronized tree growth (one dispatch "
             "per boosting iteration, no per-split host sync). auto = on "
             "for TPU backends when the config is eligible (serial learner, "
-            "single model, numerical features, no bagging/monotone/forced "
-            "splits); off = always use the host-driven learner",
+            "no monotone constraints/forced splits/renew-tree objectives); "
+            "off = always use the host-driven learner",
        section="device"),
+    _p("fused_chunk", int, 20, (),
+       check=">= 0",
+       desc="boosting iterations fused into ONE device dispatch by the "
+            "multi-iteration training path (GBDT.train_chunked): gradients, "
+            "bagging/feature_fraction draws and tree growth run inside a "
+            "single lax.scan. Drivers (engine.train, the CLI, the C API's "
+            "UpdateChunked) cap each dispatch at the next callback/eval/"
+            "snapshot boundary so observable cadence is unchanged; <= 1 "
+            "disables fusing", section="device"),
     _p("deterministic", bool, True, (),
        desc="bit-deterministic device reductions where possible", section="device"),
 )

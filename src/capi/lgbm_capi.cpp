@@ -261,6 +261,21 @@ extern "C" int LGBM_BoosterUpdateOneIter(BoosterHandle handle,
   return rc;
 }
 
+extern "C" int LGBM_BoosterUpdateChunked(BoosterHandle handle,
+                                         int num_iters, int chunk,
+                                         int* is_finished) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(Lii)", static_cast<long long>(as_id(handle)), num_iters, chunk);
+  int64_t fin = 0;
+  int rc = int_result(call_adapter("booster_update_chunked", args), &fin);
+  if (rc == 0 && is_finished != nullptr) {
+    *is_finished = static_cast<int>(fin);
+  }
+  return rc;
+}
+
 extern "C" int LGBM_BoosterGetCurrentIteration(BoosterHandle handle,
                                                int64_t* out_iteration) {
   ensure_python();

@@ -107,11 +107,14 @@ int main() {
     BoosterHandle target;
     check(LGBM_BoosterCreate(trainData, trainParams, &target),
           "BoosterCreate");
-    for (int i = 0; i < std::stoi(trainParams["num_iterations"]); i++) {
+    /* fused driver: the whole window's iterations in chunked device
+     * dispatches (falls back per-iteration when not eligible) */
+    {
       int isFinished;
-      check(LGBM_BoosterUpdateOneIter(target, &isFinished),
-            "UpdateOneIter");
-      if (isFinished) break;
+      check(LGBM_BoosterUpdateChunked(
+                target, std::stoi(trainParams["num_iterations"]),
+                /*chunk=*/10, &isFinished),
+            "UpdateChunked");
     }
     if (!init) {
       check(LGBM_BoosterFree(booster), "BoosterFree(old)");

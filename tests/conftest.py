@@ -38,6 +38,50 @@ import pytest  # noqa: E402
 REFERENCE_EXAMPLES = "/root/reference/examples"
 
 
+def pytest_configure(config):
+    # @pytest.mark.timeout(N) comes from the pytest-timeout plugin (dev
+    # extras).  When the plugin is absent the mark must still be KNOWN
+    # (no unknown-mark warning) and ENFORCED — the SIGALRM fixture below
+    # supplies the enforcement, so the 420 s multiprocess guard exists
+    # on bare tier-1 environments too.
+    if not config.pluginmanager.hasplugin("timeout"):
+        config.addinivalue_line(
+            "markers",
+            "timeout(seconds): fail the test if it runs longer than "
+            "`seconds` (SIGALRM fallback when pytest-timeout is not "
+            "installed)")
+
+
+@pytest.fixture(autouse=True)
+def _timeout_guard(request):
+    """SIGALRM-based enforcement of @pytest.mark.timeout when the
+    pytest-timeout plugin is unavailable (main-thread, POSIX only —
+    exactly the tier-1 environment)."""
+    marker = request.node.get_closest_marker("timeout")
+    if (marker is None
+            or request.config.pluginmanager.hasplugin("timeout")):
+        yield
+        return
+    import signal
+    import threading
+    seconds = int(marker.args[0]) if marker.args else 0
+    if seconds <= 0 or threading.current_thread() is not threading.main_thread():
+        yield
+        return
+
+    def _raise(signum, frame):
+        pytest.fail(f"test exceeded the {seconds}s timeout mark",
+                    pytrace=False)
+
+    old = signal.signal(signal.SIGALRM, _raise)
+    signal.alarm(seconds)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
 def load_svmlight(path, n_features=None):
     """Tiny LibSVM reader for the lambdarank fixtures."""
     labels, rows, cols, vals = [], [], [], []

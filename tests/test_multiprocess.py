@@ -34,6 +34,8 @@ def _free_port():
     return port
 
 
+# enforced by pytest-timeout when installed, else by the SIGALRM
+# fallback fixture in conftest.py — either way the 420 s cap is real
 @pytest.mark.timeout(420)
 def test_two_process_distributed(tmp_path):
     nproc = 2
