@@ -103,6 +103,51 @@ def _waves_per_tree(bst):
     return round(tot / max(cnt, 1), 2)
 
 
+def _phases_from_obs() -> dict:
+    """Per-phase totals reconstructed from the obs span data.
+
+    The fused path (train_chunked) never touches the legacy TRAIN_TIMER,
+    which left ``phases_s`` empty in BENCH_r05.json; the obs registry
+    records the ``train.chunk`` spans (plus any phase.* timings from the
+    host path) either way, so chunked runs keep per-phase attribution."""
+    from lightgbm_tpu import obs
+    if not obs.enabled():
+        return {}
+    timings = obs.registry().snapshot()["timings"]
+    out = {}
+    for name, stat in sorted(timings.items()):
+        if name.startswith(("phase.", "train.", "flush_pending",
+                            "grow.stage")):
+            out[name] = round(stat["total_s"], 3)
+    return out
+
+
+def _stage_plan_fields(bst, args) -> dict:
+    """Stage-plan attribution for the result JSON: the plan the run used
+    (+ digest), and per-stage wave probe timings measured AFTER the
+    timed region (so the probes' compiles never pollute the headline).
+    ``--wave-plan profiled`` installs the derived plan at init instead;
+    here we only report what profiling measures/would choose."""
+    grower = getattr(bst, "_grower", None)
+    if grower is None:
+        return {}
+    from lightgbm_tpu.ops import stage_plan as sp
+    out = {
+        "stage_plan": [[w, c] for w, c in grower.stage_plan],
+        "stage_plan_digest": sp.plan_digest(grower.stage_plan),
+        "stage_plan_source": grower.plan_source,
+    }
+    if not args.no_stage_profile:
+        prof = grower.profile_stage_plan(reps=2, install=False)
+        out["stage_wave_ms"] = {str(k): v
+                                for k, v in prof["stage_ms"].items()}
+        out["stage_fixed_ms"] = prof["fixed_ms"]
+        out["stage_col_ms"] = prof["col_ms"]
+        out["stage_plan_profiled"] = [[w, c] for w, c in prof["plan"]]
+        out["stage_plan_profiled_digest"] = prof["plan_digest"]
+    return out
+
+
 def synth_higgs(rows: int, cols: int = 28, seed: int = 7):
     """Standard-normal features with a planted nonlinear binary signal.
 
@@ -176,6 +221,8 @@ def run_higgs(args) -> dict:
         "min_data_in_leaf": 20, "min_sum_hessian_in_leaf": 1e-3,
         "bagging_fraction": 1.0, "feature_fraction": 1.0,
         "verbosity": 0,
+        "grad_quant_bits": args.quant_bits,
+        "wave_plan": args.wave_plan,
         "device_growth": {"device": "on", "host": "off",
                           "auto": "auto"}[args.engine],
     })
@@ -244,6 +291,9 @@ def run_higgs(args) -> dict:
 
     iters_run = bst.num_iterations()
     phases = {k: round(v, 3) for k, v in sorted(TRAIN_TIMER.acc.items())}
+    if not phases:
+        # fused path: TRAIN_TIMER never runs — rebuild from obs spans
+        phases = _phases_from_obs()
     waves_per_tree = _waves_per_tree(bst)
     if args.profile and getattr(bst, "_grower", None) is not None:
         # per-phase ms for ONE wave's components, separately jitted and
@@ -270,6 +320,7 @@ def run_higgs(args) -> dict:
         # NOT comparable with AUC numbers on the real HIGGS dataset
         "auc_synth": round(auc, 6) if auc is not None else None,
         "waves_per_tree": waves_per_tree,
+        "grad_quant_bits": args.quant_bits,
         "backend": backend,
         "device": dev,
         "phases_s": phases,
@@ -280,6 +331,7 @@ def run_higgs(args) -> dict:
         "fused_chunk": chunk,
         "host_sentinel_ms": [sentinel_pre, sentinel_post],
     }
+    result.update(_stage_plan_fields(bst, args))
     return result
 
 
@@ -456,6 +508,26 @@ def main() -> int:
                          "(slows the run; don't use for the headline number)")
     ap.add_argument("--eval-rows", type=int, default=500_000,
                     help="held-out rows for AUC (0 disables)")
+    ap.add_argument("--quant-bits", type=int,
+                    default=int(os.environ.get("BENCH_QUANT_BITS", "0")),
+                    choices=[0, 8],
+                    help="grad_quant_bits: 8 = int8 stochastic-rounded "
+                         "gradient histograms on the MXU's int8->int32 "
+                         "path (dequantized before split gains, f32 leaf "
+                         "refit); 0 = full-precision bf16 hi/lo")
+    ap.add_argument("--wave-plan", choices=["auto", "fixed", "profiled"],
+                    default=os.environ.get("BENCH_WAVE_PLAN", "auto"),
+                    help="device grower stage plan: profiled = measure "
+                         "per-stage wave cost at init and install the "
+                         "derived plan; fixed = the byte-stable doubling "
+                         "plan; auto = fixed unless a profiled plan is "
+                         "cached for this shape/config")
+    ap.add_argument("--no-stage-profile", action="store_true",
+                    default=os.environ.get("BENCH_STAGE_PROFILE", "")
+                    .lower() in ("0", "false", "no"),
+                    help="skip the post-run per-stage wave probes (they "
+                         "run AFTER the timed region and only add the "
+                         "stage_wave_ms/stage_plan_profiled JSON fields)")
     ap.add_argument("--engine", choices=["auto", "device", "host"],
                     default="device",
                     help="device = on-device wave grower (one dispatch per "

@@ -125,12 +125,16 @@ class _RecStack:
     (``DeviceGrower.fused_train`` output): ONE async device->host copy
     serves every tree in the chunk."""
 
-    __slots__ = ("arrs", "_host")
+    __slots__ = ("arrs", "_host", "qscales")
 
-    def __init__(self, rec_i, rec_f, rec_c, nl):
+    def __init__(self, rec_i, rec_f, rec_c, nl, qscales=None):
         self.arrs = (rec_i, rec_f, rec_c, nl)
         self._host = None
-        for a in self.arrs:
+        # (K, 2) per-tree quantization scales (grad_quant_bits only);
+        # fetched lazily with the lagged stall check so gauge recording
+        # never blocks the dispatch pipeline
+        self.qscales = qscales
+        for a in self.arrs + ((qscales,) if qscales is not None else ()):
             try:
                 a.copy_to_host_async()
             except AttributeError:
@@ -186,7 +190,9 @@ class GBDT:
         self.best_iteration = -1
         self._grower = None
         self._device_stop = False
-        self._nl_queue: List = []   # in-flight num_leaves handles (lagged)
+        # in-flight (num_leaves handles, quant-scale handle) per
+        # iteration, fetched with a 4-iteration lag
+        self._nl_queue: List = []
         self._wave_handles: List = []  # per-iter wave counts (device scalars)
         self._fused_grad = False    # cached objective.device_grad() result
         self._last_chunk_stack = None   # previous fused chunk's _RecStack
@@ -277,6 +283,13 @@ class GBDT:
                 self._grower = DeviceGrower(train_set, cfg)
                 log_info("Using on-device tree growth (device_growth="
                          f"{mode})")
+                if str(getattr(cfg, "wave_plan", "auto")).lower() \
+                        == "profiled":
+                    # measure per-stage wave cost on the real binned
+                    # matrix and install the derived stage plan; the
+                    # plan is cached per (shape, config) signature, so
+                    # later windows skip the measurement
+                    self._grower.profile_stage_plan()
             elif mode == "on":
                 log_warning("device_growth=on requested but the "
                             "configuration is not eligible (monotone "
@@ -476,6 +489,7 @@ class GBDT:
         row_mask = self._device_row_mask()
         shrink = self.shrinkage_rate * self._tree_multiplier()
         nls = []
+        last_qscale = None
         first_iter = len(self.models) < self.num_model
         for k in range(self.num_model):
             if not self.class_need_train[k]:
@@ -496,13 +510,14 @@ class GBDT:
             # global tree index so the fused scan draws the SAME masks
             # (grow.feature_fraction_mask; the host learner keeps its
             # own numpy stream)
-            mask = self._grower.feature_mask_for(
-                self.iter * self.num_model + k)
-            score, rec_i, rec_f, rec_c, nl, root_val, waves = \
+            tree_idx = self.iter * self.num_model + k
+            mask = self._grower.feature_mask_for(tree_idx)
+            score, rec_i, rec_f, rec_c, nl, root_val, waves, qscale = \
                 self._grower.grow_one_iter(
                     self.train_score[k], grad[k], hess[k], mask, shrink,
-                    row_mask)
+                    row_mask, tree_idx=tree_idx)
             self.train_score = self.train_score.at[k].set(score)
+            last_qscale = qscale
             self._wave_handles.append(waves)
             self.models.append(_PendingTree(
                 rec_i, rec_f, rec_c, nl, root_val, shrink,
@@ -514,10 +529,16 @@ class GBDT:
         # is hundreds of ms of device work), so this never blocks the
         # host and never stalls the dispatch pipeline, yet training
         # stops at most 4 wasted dispatches after a stall (the reference
-        # checks every iteration, gbdt.cpp:412)
-        self._nl_queue.append(nls)
+        # checks every iteration, gbdt.cpp:412).  Quantization-scale
+        # gauge handles ride the same queue (same lag, same fetch point).
+        if not (last_qscale is not None and obs.enabled()
+                and getattr(self._grower, "quant_bits", 0)):
+            last_qscale = None
+        self._nl_queue.append((nls, last_qscale))
         if len(self._nl_queue) > 4:
-            old = self._nl_queue.pop(0)
+            old, old_qs = self._nl_queue.pop(0)
+            if old_qs is not None:
+                self._record_quant_scales(jax.device_get(old_qs).tolist())
             # one batched fetch of the lagged handles (their async copies
             # landed iterations ago) instead of a blocking per-class
             # round trip
@@ -598,14 +619,16 @@ class GBDT:
             bias = self.boost_from_average(0) if not self.models else 0.0
             fused = self._grower.fused_train(chunk)
             t0 = time.perf_counter() if obs.enabled() else None
-            score, (rec_i, rec_f, rec_c, nl, _root, waves) = fused(
-                self._grower.binned, self._grower.binned_t,
-                self.train_score[0], lr, gargs,
-                jnp.asarray(self.iter, jnp.int32), grad_fn=grad_fn)
+            score, (rec_i, rec_f, rec_c, nl, _root, waves, qscales) = \
+                fused(self._grower.binned, self._grower.binned_t,
+                      self.train_score[0], lr, gargs,
+                      jnp.asarray(self.iter, jnp.int32), grad_fn=grad_fn)
             if t0 is not None:
                 self._obs_chunk(t0, chunk, score)
             self.train_score = self.train_score.at[0].set(score)
-            stack = _RecStack(rec_i, rec_f, rec_c, nl)
+            quant = bool(getattr(self._grower, "quant_bits", 0))
+            stack = _RecStack(rec_i, rec_f, rec_c, nl,
+                              qscales if quant else None)
             for i in range(chunk):
                 self.models.append(_PendingChunkTree(
                     stack, i, self.shrinkage_rate * self._tree_multiplier(),
@@ -618,9 +641,16 @@ class GBDT:
             # landed by now (this chunk is seconds of device work), so
             # reading them never blocks the dispatch pipeline
             prev, self._last_chunk_stack = self._last_chunk_stack, stack
-            if prev is not None and (prev.host()[3] <= 1).all():
-                self._trim_device_stumps()
-                return True
+            if prev is not None:
+                if prev.qscales is not None and obs.enabled():
+                    # lagged fetch (the previous chunk's copies landed
+                    # long ago): record the chunk's last per-tree
+                    # quantization scales without stalling dispatch
+                    self._record_quant_scales(
+                        np.asarray(prev.qscales)[-1].tolist())
+                if (prev.host()[3] <= 1).all():
+                    self._trim_device_stumps()
+                    return True
         if fused_ran:
             self._sync_fused_bagging()
         return False
@@ -664,6 +694,15 @@ class GBDT:
         for _ in range(chunk):
             STATE.registry.observe("train.iter", dt / chunk)
         obs.sample_device_memory()
+
+    @staticmethod
+    def _record_quant_scales(pair) -> None:
+        """Record an already-fetched lagged (scale_g, scale_h) pair —
+        the single place the gauge names live for both the
+        per-iteration and fused paths."""
+        sg_v, sh_v = pair
+        obs.set_gauge("quant.scale_g", sg_v)
+        obs.set_gauge("quant.scale_h", sh_v)
 
     def _trim_device_stumps(self):
         """Remove trailing stump iterations (the device path keeps

@@ -234,6 +234,24 @@ class Config:
         if self.tpu_double_precision:
             self.gpu_use_dp = True
 
+        if self.grad_quant_bits not in (0, 8):
+            raise ValueError(
+                f"grad_quant_bits={self.grad_quant_bits} is not supported:"
+                f" use 0 (off) or 8 (int8 quantized histograms)")
+        if self.grad_quant_bits and self.gpu_use_dp:
+            # dp asks for extra-precision accumulation; quantization asks
+            # for less — precision wins, like the reference's gpu_use_dp
+            # overriding its single-precision histogram default
+            log_warning("grad_quant_bits is ignored with gpu_use_dp "
+                        "(double-precision accumulation requested); "
+                        "disabling quantized histograms")
+            self.grad_quant_bits = 0
+
+        wp = str(self.wave_plan).strip().lower()
+        if wp not in ("auto", "fixed", "profiled"):
+            raise ValueError(f"unknown wave_plan: {self.wave_plan}")
+        self.wave_plan = wp
+
     # -- misc -------------------------------------------------------------
     def to_dict(self) -> Dict[str, Any]:
         d = {p: getattr(self, p) for p in PARAM_BY_NAME}

@@ -13,6 +13,44 @@ import jax.numpy as jnp
 import numpy as np
 
 
+class DeviceGradFn:
+    """Hashable wrapper for a fused-path gradient function.
+
+    ``DeviceGrower.fused_train`` passes ``grad_fn`` as a jax.jit STATIC
+    argument, and jit compares static args by ``__eq__``/``__hash__`` —
+    for a plain closure that is identity, so the fresh closure each new
+    GBDT window builds would re-trace (and re-compile) the whole fused
+    scan despite the process-level program cache hitting.  ``key`` must
+    capture EVERY static fact the gradient trace depends on beyond the
+    ``args`` pytree (scalar hyper-params, closed-over tables): equal
+    keys reuse the first wrapper's compiled trace verbatim.
+    """
+
+    __slots__ = ("fn", "key")
+
+    def __init__(self, fn, key: tuple):
+        self.fn = fn
+        self.key = key
+
+    def __call__(self, score, args):
+        return self.fn(score, args)
+
+    def __eq__(self, other):
+        return isinstance(other, DeviceGradFn) and other.key == self.key
+
+    def __hash__(self):
+        return hash(self.key)
+
+    def __repr__(self):
+        return f"DeviceGradFn{self.key!r}"
+
+    @property
+    def obs_signature(self) -> str:
+        # obs jit tracking represents callables by __qualname__, which
+        # cannot distinguish wrapper instances; the key can
+        return repr(self)
+
+
 class ObjectiveFunction:
     name = "none"
     is_constant_hessian = False

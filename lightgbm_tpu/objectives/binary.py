@@ -9,10 +9,32 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs as _obs
 from ..utils.log import LightGBMError, log_info, log_warning
-from .base import ObjectiveFunction
+from .base import DeviceGradFn, ObjectiveFunction
 
 K_EPSILON = 1e-15
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _logloss_grad(sigmoid, score, sign_label, label_weight, weights):
+    """One formula for the per-iteration and fused paths.  Module-level
+    (keyed on the sigmoid value, not an objective instance) so the jit
+    cache survives across retrain windows and the fused-path wrapper
+    does not have to close over the objective — a closed-over instance
+    would pin its per-row device arrays in jit's static-arg cache for
+    the process lifetime (retrain-every-window harness)."""
+    response = (-sign_label * sigmoid
+                / (1.0 + jnp.exp(sign_label * sigmoid * score)))
+    abs_r = jnp.abs(response)
+    g = response * label_weight
+    h = abs_r * (sigmoid - abs_r) * label_weight
+    if weights is not None:
+        g, h = g * weights, h * weights
+    return g, h
+
+
+_logloss_grad = _obs.track_jit("binary_grad", _logloss_grad)
 
 
 class BinaryLogloss(ObjectiveFunction):
@@ -52,16 +74,9 @@ class BinaryLogloss(ObjectiveFunction):
         self.label_weight_d = jnp.asarray(np.where(is_pos, w_pos, w_neg),
                                           jnp.float32)
 
-    @functools.partial(jax.jit, static_argnums=0)
     def _grad(self, score, sign_label, label_weight, weights):
-        response = (-sign_label * self.sigmoid
-                    / (1.0 + jnp.exp(sign_label * self.sigmoid * score)))
-        abs_r = jnp.abs(response)
-        g = response * label_weight
-        h = abs_r * (self.sigmoid - abs_r) * label_weight
-        if weights is not None:
-            g, h = g * weights, h * weights
-        return g, h
+        return _logloss_grad(self.sigmoid, score, sign_label,
+                             label_weight, weights)
 
     def get_gradients(self, scores):
         return self._grad(scores[0].astype(jnp.float32), self.sign_label_d,
@@ -70,13 +85,17 @@ class BinaryLogloss(ObjectiveFunction):
     def device_grad(self):
         if not self.need_train:
             return None
+        sigmoid = self.sigmoid   # close over the scalar, NOT self
 
         def fn(score, args):
-            # _grad inlines when traced inside the fused scan, so the
-            # fused and per-iteration paths share one formula
-            return self._grad(score, *args)
+            # _logloss_grad inlines when traced inside the fused scan,
+            # so the fused and per-iteration paths share one formula
+            return _logloss_grad(sigmoid, score, *args)
 
-        return fn, (self.sign_label_d, self.label_weight_d, self.weights_d)
+        # sigmoid is the only static fact of the trace beyond the args
+        # pytree (weights None-ness lives in the pytree structure)
+        return (DeviceGradFn(fn, ("binary", sigmoid)),
+                (self.sign_label_d, self.label_weight_d, self.weights_d))
 
     def boost_from_score(self, class_id):
         is_pos = (self.label > 0).astype(np.float64)

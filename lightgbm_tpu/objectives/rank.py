@@ -22,8 +22,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs as _obs
 from ..utils.log import LightGBMError
-from .base import ObjectiveFunction
+from .base import DeviceGradFn, ObjectiveFunction
 
 _PAIR_BUDGET = 1 << 24   # floats in flight per batch (P*P*B)
 
@@ -115,80 +116,11 @@ class LambdarankNDCG(ObjectiveFunction):
         self._grad_batches = tuple(self._buckets[p]["batch"]
                                    for p in order)
 
-    # ------------------------------------------------------------------
-    def _bucket_grads(self, score_ext, rows, labels, valid, inv_mdcg, batch):
-        """score_ext: (N+1,) scores with trailing dummy 0."""
-        p = rows.shape[1]
-        disc_all = 1.0 / jnp.log2(jnp.arange(2, 2 + p, dtype=jnp.float32))
-
-        def one_batch(args):
-            r, l, v, inv = args                      # (B,P) ... (B,)
-            s = score_ext[r]
-
-            def one_query(s_q, l_q, v_q, inv_q):
-                neg = jnp.where(v_q, s_q, -jnp.inf)
-                order = jnp.argsort(-neg, stable=True)
-                ss = s_q[order]
-                ls = l_q[order]
-                vs = v_q[order]
-                g = self._gain_table[jnp.clip(ls, 0, None)]
-                cnt = vs.sum()
-                best = ss[0]
-                worst = ss[jnp.maximum(cnt - 1, 0)]
-                delta = ss[:, None] - ss[None, :]
-                dgap = g[:, None] - g[None, :]
-                pdisc = jnp.abs(disc_all[:, None] - disc_all[None, :])
-                dndcg = dgap * pdisc * inv_q
-                norm = (best != worst)
-                dndcg = jnp.where(norm, dndcg / (0.01 + jnp.abs(delta)),
-                                  dndcg)
-                mask = (vs[:, None] & vs[None, :]
-                        & (ls[:, None] > ls[None, :]))
-                sig = 2.0 / (1.0 + jnp.exp(2.0 * self.sigmoid * delta))
-                lam = jnp.where(mask, -dndcg * sig, 0.0)
-                hes = jnp.where(mask, 2.0 * dndcg * sig * (2.0 - sig), 0.0)
-                lam_s = lam.sum(axis=1) - lam.sum(axis=0)
-                hes_s = hes.sum(axis=1) + hes.sum(axis=0)
-                inv_order = jnp.argsort(order, stable=True)
-                return lam_s[inv_order], hes_s[inv_order]
-
-            return jax.vmap(one_query)(s, l, v, inv)
-
-        q = rows.shape[0]
-        pad_q = (-q) % batch
-        if pad_q:
-            zpad = lambda a, fill: jnp.concatenate(
-                [a, jnp.full((pad_q,) + a.shape[1:], fill, a.dtype)])
-            rows = zpad(rows, score_ext.shape[0] - 1)
-            labels = zpad(labels, 0)
-            valid = zpad(valid, False)
-            inv_mdcg = zpad(inv_mdcg, 0.0)
-        nb = rows.shape[0] // batch
-        shp = lambda a: a.reshape((nb, batch) + a.shape[1:])
-        lam, hes = jax.lax.map(
-            one_batch, (shp(rows), shp(labels), shp(valid), shp(inv_mdcg)))
-        return lam.reshape(-1, p)[:q], hes.reshape(-1, p)[:q]
-
-    @functools.partial(jax.jit, static_argnums=(0, 3))
-    def _all_grads(self, score_ext, bucket_arrays, batches, inv_perm):
-        """All buckets in ONE compiled program: ~11 small dispatches (a
-        ~6 ms tunnel floor each) collapse into one."""
-        flats = []
-        for (rows, labels, valid, inv_mdcg), batch in zip(bucket_arrays,
-                                                          batches):
-            lam, hes = self._bucket_grads(score_ext, rows, labels, valid,
-                                          inv_mdcg, batch)
-            flats.append(jnp.stack([lam.reshape(-1), hes.reshape(-1)], 1))
-        # every data row occurs exactly once across buckets: assemble by
-        # gathering the concatenated flat results at the precomputed
-        # positions (one gather vs 2x buckets scatter-adds)
-        return jnp.concatenate(flats)[inv_perm]
-
     def get_gradients(self, scores):
         score_ext = jnp.concatenate(
             [scores[0].astype(jnp.float32), jnp.zeros(1, jnp.float32)])
-        gh = self._all_grads(score_ext, self._grad_arrays,
-                             self._grad_batches, self._inv_perm)
+        gh = _all_grads(self._gain_table, score_ext, self._grad_arrays,
+                        self._grad_batches, self.sigmoid, self._inv_perm)
         grad, hess = gh[:, 0], gh[:, 1]
         if self.weights_d is not None:
             grad = grad * self.weights_d
@@ -196,6 +128,12 @@ class LambdarankNDCG(ObjectiveFunction):
         return grad, hess
 
     def device_grad(self):
+        # close over the small static facts only (gain table: ~31
+        # floats; batches/sigmoid: scalars), NOT self — a closed-over
+        # objective would pin its per-row bucket/permutation device
+        # arrays in jit's static-arg cache for the process lifetime
+        gain_table = self._gain_table
+        sigmoid = self.sigmoid
         batches = self._grad_batches   # static ints, safe to close over
 
         def fn(score, args):
@@ -204,14 +142,98 @@ class LambdarankNDCG(ObjectiveFunction):
             bucket_arrays, inv_perm, weights = args
             score_ext = jnp.concatenate(
                 [score, jnp.zeros(1, jnp.float32)])
-            gh = self._all_grads(score_ext, bucket_arrays, batches,
-                                 inv_perm)
+            gh = _all_grads(gain_table, score_ext, bucket_arrays,
+                            batches, sigmoid, inv_perm)
             g, h = gh[:, 0], gh[:, 1]
             if weights is not None:
                 g, h = g * weights, h * weights
             return g, h
 
-        return fn, (self._grad_arrays, self._inv_perm, self.weights_d)
+        # static facts of the trace: sigmoid + label_gain feed the
+        # closed-over gain table constant, batches shape the unrolled
+        # bucket loop
+        return (DeviceGradFn(
+            fn, ("lambdarank", sigmoid, tuple(self.label_gain),
+                 batches)),
+            (self._grad_arrays, self._inv_perm, self.weights_d))
 
     def to_string(self):
         return self.name
+
+
+def _bucket_grads(gain_table, sigmoid, score_ext, rows, labels, valid,
+                  inv_mdcg, batch):
+    """score_ext: (N+1,) scores with trailing dummy 0."""
+    p = rows.shape[1]
+    disc_all = 1.0 / jnp.log2(jnp.arange(2, 2 + p, dtype=jnp.float32))
+
+    def one_batch(args):
+        r, l, v, inv = args                      # (B,P) ... (B,)
+        s = score_ext[r]
+
+        def one_query(s_q, l_q, v_q, inv_q):
+            neg = jnp.where(v_q, s_q, -jnp.inf)
+            order = jnp.argsort(-neg, stable=True)
+            ss = s_q[order]
+            ls = l_q[order]
+            vs = v_q[order]
+            g = gain_table[jnp.clip(ls, 0, None)]
+            cnt = vs.sum()
+            best = ss[0]
+            worst = ss[jnp.maximum(cnt - 1, 0)]
+            delta = ss[:, None] - ss[None, :]
+            dgap = g[:, None] - g[None, :]
+            pdisc = jnp.abs(disc_all[:, None] - disc_all[None, :])
+            dndcg = dgap * pdisc * inv_q
+            norm = (best != worst)
+            dndcg = jnp.where(norm, dndcg / (0.01 + jnp.abs(delta)),
+                              dndcg)
+            mask = (vs[:, None] & vs[None, :]
+                    & (ls[:, None] > ls[None, :]))
+            sig = 2.0 / (1.0 + jnp.exp(2.0 * sigmoid * delta))
+            lam = jnp.where(mask, -dndcg * sig, 0.0)
+            hes = jnp.where(mask, 2.0 * dndcg * sig * (2.0 - sig), 0.0)
+            lam_s = lam.sum(axis=1) - lam.sum(axis=0)
+            hes_s = hes.sum(axis=1) + hes.sum(axis=0)
+            inv_order = jnp.argsort(order, stable=True)
+            return lam_s[inv_order], hes_s[inv_order]
+
+        return jax.vmap(one_query)(s, l, v, inv)
+
+    q = rows.shape[0]
+    pad_q = (-q) % batch
+    if pad_q:
+        zpad = lambda a, fill: jnp.concatenate(
+            [a, jnp.full((pad_q,) + a.shape[1:], fill, a.dtype)])
+        rows = zpad(rows, score_ext.shape[0] - 1)
+        labels = zpad(labels, 0)
+        valid = zpad(valid, False)
+        inv_mdcg = zpad(inv_mdcg, 0.0)
+    nb = rows.shape[0] // batch
+    shp = lambda a: a.reshape((nb, batch) + a.shape[1:])
+    lam, hes = jax.lax.map(
+        one_batch, (shp(rows), shp(labels), shp(valid), shp(inv_mdcg)))
+    return lam.reshape(-1, p)[:q], hes.reshape(-1, p)[:q]
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def _all_grads(gain_table, score_ext, bucket_arrays, batches, sigmoid,
+               inv_perm):
+    """All buckets in ONE compiled program: ~11 small dispatches (a
+    ~6 ms tunnel floor each) collapse into one.  Module-level (keyed on
+    the batches/sigmoid values, not an objective instance) so the jit
+    cache survives across retrain windows and the fused-path wrapper
+    does not retain the objective's per-row device arrays."""
+    flats = []
+    for (rows, labels, valid, inv_mdcg), batch in zip(bucket_arrays,
+                                                      batches):
+        lam, hes = _bucket_grads(gain_table, sigmoid, score_ext, rows,
+                                 labels, valid, inv_mdcg, batch)
+        flats.append(jnp.stack([lam.reshape(-1), hes.reshape(-1)], 1))
+    # every data row occurs exactly once across buckets: assemble by
+    # gathering the concatenated flat results at the precomputed
+    # positions (one gather vs 2x buckets scatter-adds)
+    return jnp.concatenate(flats)[inv_perm]
+
+
+_all_grads = _obs.track_jit("rank_all_grads", _all_grads)

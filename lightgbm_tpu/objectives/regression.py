@@ -12,12 +12,120 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs as _obs
 from ..utils.log import LightGBMError, log_warning
-from .base import ObjectiveFunction, percentile, weighted_percentile
+from .base import DeviceGradFn, ObjectiveFunction, percentile, weighted_percentile
 
 
 def _sign(x):
     return jnp.where(x > 0, 1.0, jnp.where(x < 0, -1.0, 0.0))
+
+
+@jax.jit
+def _l2_grad(score, label, weights):
+    """One formula for the per-iteration and fused paths; module-level
+    so the jit cache survives across retrain windows and the fused-path
+    wrapper retains no objective instance (which would pin its per-row
+    device arrays in jit's static-arg cache for the process lifetime)."""
+    diff = score - label
+    w = jnp.ones_like(score) if weights is None else weights
+    return diff * w, w
+
+
+_l2_grad = _obs.track_jit("l2_grad", _l2_grad)
+
+
+def _l2_device_fn(score, args):
+    # _l2_grad inlines when traced inside the fused scan
+    return _l2_grad(score, *args)
+
+
+# The sibling objectives' formulas live at module level for the same
+# reason as _l2_grad: a jitted instance method makes the instance a
+# static arg, pinning its per-row label/weight device arrays (and one
+# trace per retrain window's fresh objective) in jit's cache for the
+# process lifetime.  Scalar hyper-params are static argnums — one trace
+# per distinct value, exactly the per-instance behavior, minus the leak.
+
+@jax.jit
+def _l1_grad(score, label, weights):
+    diff = score - label
+    w = jnp.ones_like(score) if weights is None else weights
+    return _sign(diff) * w, w
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _huber_grad(alpha, score, label, weights):
+    diff = score - label
+    g = jnp.where(jnp.abs(diff) <= alpha, diff, _sign(diff) * alpha)
+    w = jnp.ones_like(score) if weights is None else weights
+    return g * w, w
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _fair_grad(c, score, label, weights):
+    x = score - label
+    ax = jnp.abs(x)
+    g = c * x / (ax + c)
+    h = c * c / ((ax + c) ** 2)
+    if weights is not None:
+        g, h = g * weights, h * weights
+    return g, h
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _poisson_grad(max_delta_step, score, label, weights):
+    g = jnp.exp(score) - label
+    h = jnp.exp(score + max_delta_step)
+    if weights is not None:
+        g, h = g * weights, h * weights
+    return g, h
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _quantile_grad(alpha, score, label, weights):
+    delta = score - label
+    g = jnp.where(delta >= 0, 1.0 - alpha, -alpha)
+    w = jnp.ones_like(score) if weights is None else weights
+    return g * w, w
+
+
+@jax.jit
+def _mape_grad(score, label, label_weight, weights):
+    diff = score - label
+    g = _sign(diff) * label_weight
+    h = jnp.ones_like(score) if weights is None else weights
+    return g, h
+
+
+@jax.jit
+def _gamma_grad(score, label, weights):
+    g = 1.0 - label * jnp.exp(-score)
+    h = label * jnp.exp(-score)
+    if weights is not None:
+        g, h = g * weights, h * weights
+    return g, h
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _tweedie_grad(rho, score, label, weights):
+    e1 = jnp.exp((1.0 - rho) * score)
+    e2 = jnp.exp((2.0 - rho) * score)
+    g = -label * e1 + e2
+    h = -label * (1.0 - rho) * e1 + (2.0 - rho) * e2
+    if weights is not None:
+        g, h = g * weights, h * weights
+    return g, h
+
+
+_l1_grad = _obs.track_jit("l1_grad", _l1_grad)
+_huber_grad = _obs.track_jit("huber_grad", _huber_grad)
+_fair_grad = _obs.track_jit("fair_grad", _fair_grad)
+_poisson_grad = _obs.track_jit("poisson_grad", _poisson_grad)
+_quantile_grad = _obs.track_jit("quantile_grad", _quantile_grad)
+_mape_grad = _obs.track_jit("mape_grad", _mape_grad)
+_gamma_grad = _obs.track_jit("gamma_grad", _gamma_grad)
+_tweedie_grad = _obs.track_jit("tweedie_grad", _tweedie_grad)
 
 
 class RegressionL2(ObjectiveFunction):
@@ -40,11 +148,8 @@ class RegressionL2(ObjectiveFunction):
         self.is_constant_hessian = self.weights is None and \
             type(self) is RegressionL2
 
-    @functools.partial(jax.jit, static_argnums=0)
     def _grad(self, score, label, weights):
-        diff = score - label
-        w = jnp.ones_like(score) if weights is None else weights
-        return diff * w, w
+        return _l2_grad(score, label, weights)
 
     def get_gradients(self, scores):
         return self._grad(scores[0].astype(jnp.float32), self.label_d,
@@ -55,12 +160,10 @@ class RegressionL2(ObjectiveFunction):
         # known to be this formula
         if type(self) is not RegressionL2:
             return None
-
-        def fn(score, args):
-            # shares _grad with the per-iteration path (inlines in-scan)
-            return self._grad(score, *args)
-
-        return fn, (self.label_d, self.weights_d)
+        # module-level fn: shares _l2_grad with the per-iteration path
+        # and closes over nothing
+        return (DeviceGradFn(_l2_device_fn, ("regression_l2",)),
+                (self.label_d, self.weights_d))
 
     def boost_from_score(self, class_id):
         if self.weights is None:
@@ -85,11 +188,8 @@ class RegressionL1(RegressionL2):
     is_renew_tree_output = True
     alpha = 0.5
 
-    @functools.partial(jax.jit, static_argnums=0)
     def _grad(self, score, label, weights):
-        diff = score - label
-        w = jnp.ones_like(score) if weights is None else weights
-        return _sign(diff) * w, w
+        return _l1_grad(score, label, weights)
 
     def boost_from_score(self, class_id):
         if self.weights is None:
@@ -112,13 +212,8 @@ class Huber(RegressionL2):
         self.alpha = float(config.alpha)
         self.sqrt = False
 
-    @functools.partial(jax.jit, static_argnums=0)
     def _grad(self, score, label, weights):
-        diff = score - label
-        g = jnp.where(jnp.abs(diff) <= self.alpha, diff,
-                      _sign(diff) * self.alpha)
-        w = jnp.ones_like(score) if weights is None else weights
-        return g * w, w
+        return _huber_grad(self.alpha, score, label, weights)
 
 
 class Fair(RegressionL2):
@@ -135,15 +230,8 @@ class Fair(RegressionL2):
         super().init(metadata, num_data)
         self.is_constant_hessian = False
 
-    @functools.partial(jax.jit, static_argnums=0)
     def _grad(self, score, label, weights):
-        x = score - label
-        ax = jnp.abs(x)
-        g = self.c * x / (ax + self.c)
-        h = self.c * self.c / ((ax + self.c) ** 2)
-        if weights is not None:
-            g, h = g * weights, h * weights
-        return g, h
+        return _fair_grad(self.c, score, label, weights)
 
 
 class Poisson(RegressionL2):
@@ -167,13 +255,8 @@ class Poisson(RegressionL2):
         if self.label.sum() == 0:
             raise LightGBMError(f"[{self.name}]: sum of labels is zero")
 
-    @functools.partial(jax.jit, static_argnums=0)
     def _grad(self, score, label, weights):
-        g = jnp.exp(score) - label
-        h = jnp.exp(score + self.max_delta_step)
-        if weights is not None:
-            g, h = g * weights, h * weights
-        return g, h
+        return _poisson_grad(self.max_delta_step, score, label, weights)
 
     def boost_from_score(self, class_id):
         return float(np.log(max(RegressionL2.boost_from_score(self, 0),
@@ -195,12 +278,8 @@ class Quantile(RegressionL2):
         if not (0.0 < self.alpha < 1.0):
             raise LightGBMError("alpha should be in (0, 1) for quantile")
 
-    @functools.partial(jax.jit, static_argnums=0)
     def _grad(self, score, label, weights):
-        delta = score - label
-        g = jnp.where(delta >= 0, 1.0 - self.alpha, -self.alpha)
-        w = jnp.ones_like(score) if weights is None else weights
-        return g * w, w
+        return _quantile_grad(self.alpha, score, label, weights)
 
     def boost_from_score(self, class_id):
         if self.weights is None:
@@ -231,15 +310,8 @@ class Mape(RegressionL1):
         self.label_weight_d = jnp.asarray(self.label_weight)
 
     def get_gradients(self, scores):
-        return self._grad_mape(scores[0].astype(jnp.float32), self.label_d,
-                               self.label_weight_d, self.weights_d)
-
-    @functools.partial(jax.jit, static_argnums=0)
-    def _grad_mape(self, score, label, label_weight, weights):
-        diff = score - label
-        g = _sign(diff) * label_weight
-        h = jnp.ones_like(score) if weights is None else weights
-        return g, h
+        return _mape_grad(scores[0].astype(jnp.float32), self.label_d,
+                          self.label_weight_d, self.weights_d)
 
     def boost_from_score(self, class_id):
         return weighted_percentile(self.label, self.label_weight, 0.5)
@@ -261,13 +333,8 @@ class Gamma(Poisson):
             raise LightGBMError(
                 f"[{self.name}]: labels must be positive")
 
-    @functools.partial(jax.jit, static_argnums=0)
     def _grad(self, score, label, weights):
-        g = 1.0 - label * jnp.exp(-score)
-        h = label * jnp.exp(-score)
-        if weights is not None:
-            g, h = g * weights, h * weights
-        return g, h
+        return _gamma_grad(score, label, weights)
 
 
 class Tweedie(Poisson):
@@ -279,12 +346,5 @@ class Tweedie(Poisson):
         super().__init__(config)
         self.rho = float(config.tweedie_variance_power)
 
-    @functools.partial(jax.jit, static_argnums=0)
     def _grad(self, score, label, weights):
-        e1 = jnp.exp((1.0 - self.rho) * score)
-        e2 = jnp.exp((2.0 - self.rho) * score)
-        g = -label * e1 + e2
-        h = -label * (1.0 - self.rho) * e1 + (2.0 - self.rho) * e2
-        if weights is not None:
-            g, h = g * weights, h * weights
-        return g, h
+        return _tweedie_grad(self.rho, score, label, weights)

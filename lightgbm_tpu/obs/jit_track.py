@@ -24,6 +24,9 @@ from .state import STATE
 
 
 def _leaf_sig(leaf) -> str:
+    sig = getattr(leaf, "obs_signature", None)
+    if sig is not None:
+        return str(sig)
     shape = getattr(leaf, "shape", None)
     dtype = getattr(leaf, "dtype", None)
     if shape is not None and dtype is not None:

@@ -22,6 +22,11 @@ The TPU-native formulation is **dense**:
 * the gradient operand is split hi/lo into two bfloat16 columns whose
   float32-accumulated sum reconstructs float32-accurate histograms at
   bfloat16 matmul speed (counts are exact: 0/1 products, f32 accumulation);
+  with ``grad_quant_bits=8`` the g/h columns are instead stochastically
+  rounded to int8 against a per-tree global scale and the contraction runs
+  on the MXU's native int8->int32 path — histograms are dequantized ONCE
+  in f32 before split-gain evaluation, counts stay integer-exact, and leaf
+  values are REFIT from the full-precision gradients after growth;
 * growth is best-first like the reference (``serial_tree_learner.cpp:
   157-221``) but *wave-synchronized*: each wave evaluates the newest leaves
   (smaller sibling by direct histogram, larger by parent subtraction,
@@ -33,6 +38,16 @@ The TPU-native formulation is **dense**:
 * the whole tree grows inside one ``lax.while_loop`` — a boosting
   iteration is ONE device dispatch with nothing fetched; split records are
   copied to host asynchronously and replayed into ``Tree`` objects lazily.
+* staged wave widths come from ``ops/stage_plan.py``: the byte-stable
+  doubling default, or a profile-guided plan derived from per-stage
+  timings (``wave_plan=profiled`` / ``DeviceGrower.profile_stage_plan``).
+
+The jitted programs live on a :class:`GrowerPrograms` object that holds
+NO device data — the binned matrices, feature metadata and traced
+hyper-parameters are all arguments, so programs are shared process-wide
+through a cache keyed on (shape signature, config hash, plan digest).
+In the retrain-every-window pattern a warm second window therefore
+performs ZERO new traces (obs counters ``grow.cache_hits``/``misses``).
 
 Supports: numerical features, missing-value routing (None/Zero/NaN),
 categorical optimal splits (the winning category set travels as an
@@ -45,13 +60,18 @@ monotone constraints, forced splits, renew-tree-output objectives.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
+from . import stage_plan as stage_plan_mod
+from .histogram import bucket_size, quantize_gh
 from .split import (F_DEFAULT_LEFT, F_FEATURE, F_GAIN, F_IS_CAT, F_LEFT_C,
                     F_LEFT_G, F_LEFT_H, F_LEFT_OUT, F_RIGHT_C, F_RIGHT_G,
                     F_RIGHT_H, F_RIGHT_OUT, F_THRESHOLD, FeatureMeta,
@@ -65,19 +85,42 @@ _CHUNK = int(_os.environ.get("LGBM_TPU_CHUNK", 32768))
 # record field layout (host replay reads these)
 REC_I_FIELDS = 5    # leaf, right, feature, threshold, default_left
 REC_F_FIELDS = 9    # gain, lg, lh, lc, rg, rh, rc, left_out, right_out
+# rec_f column indices of the two leaf outputs (quant refit writes them)
+REC_F_LEFT_OUT = 7
+REC_F_RIGHT_OUT = 8
 
 # above this many rows a single f32 count cell can exceed 2^24 and lose
 # integer exactness; the wave matmul then carries TWO striped count
 # columns (each stripe < 2^24 rows, summed after accumulation — final
 # count error <= 1 ulp instead of unbounded drift).  Module-level so
-# tests can force the striped path on small data.
+# tests can force the striped path on small data.  The int8 quantized
+# path stripes its g/h columns at the same threshold: 127 * 2^24 stays
+# below the int32 accumulator limit per stripe.
 COUNT_SPLIT_ROWS = 1 << 24
-
-
 
 
 def _ceil_to(x: int, m: int) -> int:
     return (x + m - 1) // m * m
+
+
+class FTables(NamedTuple):
+    """Per-feature group/slot tables as traced device arrays (arguments,
+    not closure constants: baking them into the program would both bloat
+    the compile request and key the program cache on bin boundary
+    content instead of shape).  Only the fields ``FeatureMeta`` does NOT
+    already carry — num_bin/default_bin/missing are read from ``meta``
+    so there is one source of truth per array."""
+    group: jnp.ndarray         # (F,) int32
+    offset: jnp.ndarray        # (F,) int32
+    width: jnp.ndarray         # (F,) int32  num_bin - (default_bin == 0)
+
+    @classmethod
+    def from_dataset(cls, dataset) -> "FTables":
+        i32 = lambda a: jnp.asarray(np.asarray(a, np.int32))
+        nbins = np.asarray(dataset.f_num_bin, np.int64)
+        dbins = np.asarray(dataset.f_default_bin, np.int64)
+        return cls(i32(dataset.f_group), i32(dataset.f_offset),
+                   i32(nbins - (dbins == 0)))
 
 
 def feature_fraction_mask(seed: int, tree_idx, nf: int, k: int):
@@ -94,9 +137,11 @@ def feature_fraction_mask(seed: int, tree_idx, nf: int, k: int):
 
 
 def _combine_hist_cols(h, k: int):
-    """Collapse the K accumulated stat columns (last axis) to [g, h, cnt].
-    K=3: passthrough.  K=4: striped counts summed.  K=5: hi/lo g,h.
-    K=6: hi/lo g,h + striped counts."""
+    """Collapse the K accumulated bf16-path stat columns (last axis) to
+    [g, h, cnt].  K=3: passthrough.  K=4: striped counts summed.
+    K=5: hi/lo g,h.  K=6: pairwise sums (hi/lo g,h + striped counts).
+    The int8 quantized path combines its own stripes in ``_wave_hist``
+    (f32 for g/h — an int32 stripe SUM can wrap — int32 for counts)."""
     import jax.numpy as _jnp
     if k == 5:
         return _jnp.stack([h[..., 0] + h[..., 1], h[..., 2] + h[..., 3],
@@ -112,59 +157,75 @@ def _combine_hist_cols(h, k: int):
     return h
 
 
-class DeviceGrower:
-    """Grows whole trees on device; one dispatch per boosting iteration.
+def _hi_lo_cols(grad, hess, one):
+    """[g_hi, g_lo, h_hi, h_lo] bf16 stat columns masked by ``one``: each
+    lo column carries the bf16 rounding residual, so an f32-accumulated
+    contraction of the pair reconstructs the f32-exact sum.  Shared by
+    the gpu_use_dp histogram path and the quantized-path leaf refit."""
+    ghi = grad.astype(jnp.bfloat16)
+    hhi = hess.astype(jnp.bfloat16)
+    glo = (grad - ghi.astype(jnp.float32)).astype(jnp.bfloat16)
+    hlo = (hess - hhi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return [ghi * one, glo * one, hhi * one, hlo * one]
 
-    Parameters mirror the serial learner's (dataset, config) pair.  The
-    instance owns device copies of the binned matrix in both layouts and
-    the jitted grow function (compiled once per dataset/config shape).
-    """
 
-    def __init__(self, dataset, config):
-        self.config = config
-        self.dataset = dataset
-        self.num_data = int(dataset.num_data)
-        self.num_groups = int(dataset.num_groups)
+def _hist_layout(num_data: int, config):
+    """(quant_bits, striped, hist_cols) for this row count + config."""
+    dp = bool(getattr(config, "gpu_use_dp", False))
+    quant_bits = int(getattr(config, "grad_quant_bits", 0) or 0)
+    striped = int(num_data) >= COUNT_SPLIT_ROWS
+    if quant_bits:
+        # striped mode stripes g/h too: 127 * 2^24 per stripe stays
+        # inside the int32 accumulator
+        hist_cols = 6 if striped else 3
+    elif dp:
+        # 6 = hi/lo g,h + striped counts: dp must not reintroduce
+        # the single-column count overflow it exists to avoid
+        hist_cols = 6 if striped else 5
+    else:
+        hist_cols = 4 if striped else 3
+    return quant_bits, striped, hist_cols
+
+
+def _wave_width(num_leaves: int, hist_cols: int) -> int:
+    scale = 3.0 / hist_cols
+    wmax = max(int(128 * scale), 4)
+    return min(wmax, max(int(num_leaves) - 1, 1))
+
+
+def default_stage_plan(num_data: int, config) -> list:
+    """The legacy doubling plan :func:`get_grower_programs` resolves
+    when no explicit plan is given — the single resolution point, so
+    the digest in the program-cache key always matches the plan the
+    cached programs were traced with (and a profiled plan that equals
+    the default hits the same cache entry, not a re-trace)."""
+    _, _, hist_cols = _hist_layout(num_data, config)
+    num_leaves = int(config.num_leaves)
+    return stage_plan_mod.legacy_stage_plan(
+        num_leaves, _wave_width(num_leaves, hist_cols), hist_cols)
+
+
+class GrowerPrograms:
+    """The jitted growth programs plus every static fact their traces
+    depend on.  Holds NO device data: the binned matrices, feature
+    metadata (:class:`~.split.FeatureMeta`), traced hyper-parameters and
+    partition tables (:class:`FTables`) are call arguments, so one
+    instance serves every :class:`DeviceGrower` whose shape/config
+    signature matches (see :func:`get_grower_programs`)."""
+
+    def __init__(self, *, num_data: int, num_groups: int, nb: int,
+                 num_features: int, has_cat: bool, config,
+                 plan: list, plan_source: str = "default"):
+        self.config = config.clone()
+        config = self.config
+        self.num_data = int(num_data)
+        self.num_groups = int(num_groups)
+        self.nb = int(nb)
+        self.num_features = int(num_features)
+        self.has_cat = bool(has_cat)
         self.num_leaves = int(config.num_leaves)
-
-        # per-group slot pitch: smallest power of two covering every group
-        nb = 64
-        for g in dataset.groups:
-            while g.num_total_bin > nb:
-                nb *= 2
-        self.nb = nb
-        self.num_slots = self.num_groups * nb
-
+        self.num_slots = self.num_groups * self.nb
         self.n_pad = _ceil_to(max(self.num_data, _CHUNK), _CHUNK)
-        pad = self.n_pad - self.num_data
-        if getattr(dataset, "device_binned", False):
-            # matrix already lives in HBM (construct_from_device_matrix)
-            binned_d = dataset.binned
-            if pad:
-                binned_d = jnp.pad(binned_d, ((0, pad), (0, 0)))
-            self.binned = binned_d
-        else:
-            binned = np.asarray(dataset.binned)  # (N, G) uint8
-            if pad:
-                binned = np.pad(binned, ((0, pad), (0, 0)))
-            self.binned = jnp.asarray(binned)
-        # the (G, N) copy is a device-side transpose: uploading it
-        # separately doubled the host->device transfer and the host
-        # ascontiguousarray pass (~seconds at 10M rows)
-        self.binned_t = jnp.transpose(self.binned)
-
-        self.meta = FeatureMeta.from_dataset(dataset, slot_stride=nb)
-        self.hyper = SplitHyper.from_config(config)
-        # per-feature partition tables (device)
-        i32 = lambda a: jnp.asarray(np.asarray(a, np.int32))
-        nbins = np.asarray(dataset.f_num_bin, np.int64)
-        dbins = np.asarray(dataset.f_default_bin, np.int64)
-        self.p_group = i32(dataset.f_group)
-        self.p_offset = i32(dataset.f_offset)
-        self.p_width = i32(nbins - (dbins == 0))
-        self.p_default_bin = i32(dbins)
-        self.p_num_bin = i32(nbins)
-        self.p_missing = i32(dataset.f_missing_type)
 
         # stat columns per leaf in the wave matmul.  Default 3 — bf16
         # g/h + exact count: per-term bf16 rounding (rel ~2^-8) is
@@ -173,14 +234,10 @@ class DeviceGrower:
         # histograms, docs/GPU-Performance.rst:128-161).  gpu_use_dp
         # restores the hi/lo split (g,h each as two bf16 columns whose
         # f32-accumulated sum reconstructs f32-exact values).
-        dp = bool(getattr(config, "gpu_use_dp", False))
-        striped = self.num_data >= COUNT_SPLIT_ROWS
-        if dp:
-            # 6 = hi/lo g,h + striped counts: dp must not reintroduce
-            # the single-column count overflow it exists to avoid
-            self.hist_cols = 6 if striped else 5
-        else:
-            self.hist_cols = 4 if striped else 3
+        # grad_quant_bits=8 replaces the bf16 columns with int8
+        # stochastic-rounded g/h so the contraction runs int8->int32.
+        self.quant_bits, self.striped, self.hist_cols = _hist_layout(
+            self.num_data, config)
         # Wave cost measured on the chip (scripts/ubench_hist.py,
         # 10.5M rows): ~15.9 ms fixed (the one-hot operand generation
         # over all N, width-independent) + ~0.203 ms per stat column —
@@ -188,36 +245,30 @@ class DeviceGrower:
         # peak at 2 tiles (hist3_w84: 67.1 ms, 141.7 TF).  Since a wave
         # can split at most the current frontier, the cheapest plan
         # width-matches each stage to the frontier (doubling) and ends
-        # with one very wide multi-tile wave for the tail: for L=255,
-        # [4,16,32,64,128] costs ~290 ms/tree of histogram vs ~355 for
-        # the old single-tile cap at W=42.  gpu_use_dp (k=5) scales each
-        # width down by 3/k to hold the column budget.
-        scale = 3.0 / self.hist_cols
-        wmax = max(int(128 * scale), 4)
-        self.wave_width = min(wmax, max(self.num_leaves - 1, 1))
-        self.stage_plan = [
-            (ws, cap) for ws, cap in
-            ((4, 8), (16, 32), (max(int(32 * scale), 4), 64),
-             (max(int(64 * scale), 4), 128))
-            if ws < self.wave_width and cap < self.num_leaves
-        ] + [(self.wave_width, None)]
+        # with one very wide multi-tile wave for the tail.  gpu_use_dp
+        # (k=5) scales each width down by 3/k to hold the column budget.
+        self.wave_width = _wave_width(self.num_leaves, self.hist_cols)
+        # plan is required and resolved by get_grower_programs (its
+        # digest is part of the program-cache key — resolving it here
+        # too could silently diverge from the keyed digest)
+        self.stage_plan = [(int(w), None if c is None else int(c))
+                           for w, c in plan]
+        self.plan_source = plan_source
         # hist_kernel: "auto"/"einsum" use the XLA einsum formulation —
         # the best measured (both Pallas kernels lost to it, see
         # ops/hist_pallas.py); "pallas" opts into the VMEM kernel on
         # hardware, "interpret" runs it in interpreter mode (CPU tests).
+        # The int8 quantized path always uses the einsum (the Pallas
+        # kernel is bf16-only).
         mode = str(getattr(config, "hist_kernel", "auto")
                    or "auto").lower()
         self.pallas_interpret = mode == "interpret"
-        # v1 of the Pallas kernel measured 2x slower than the einsum
-        # (108.9 vs 53.9 ms/tree, 1M-row quick bench) - grid-step and
-        # block-layout overheads dominate at ch<=1024 VMEM budgets - so
-        # auto stays on the einsum until the kernel beats it
-        self.use_pallas = mode in ("pallas", "interpret")
-        self.lr = float(config.learning_rate)
-        # recompile tracking: every fresh DeviceGrower owns fresh jit
-        # caches, so in the retrain-every-window pattern each window
-        # recompiles these — obs.track_jit counts and attributes that
-        # per shape signature (near-free when obs is disabled)
+        self.use_pallas = (mode in ("pallas", "interpret")
+                           and not self.quant_bits)
+        # recompile tracking: these TrackedJit wrappers are shared by
+        # every grower that adopts this programs object, so in the
+        # retrain-every-window pattern a warm window re-dispatches into
+        # already-compiled programs and obs records ZERO new compiles
         self._grow = obs.track_jit(
             "grow", jax.jit(functools.partial(self._grow_impl,
                                               with_mask=False)))
@@ -225,12 +276,15 @@ class DeviceGrower:
             "grow_masked", jax.jit(functools.partial(self._grow_impl,
                                                      with_mask=True)))
         self._fused = {}   # scan length -> jitted multi-iteration program
+        # one programs object is served process-wide from _PROGRAM_CACHE,
+        # so lazy per-length entries need their own lock
+        self._fused_lock = threading.Lock()
         # sampling state for device-side draws (feature_fraction masks,
-        # fused bagging): seeds mirror the host learner's derivation
-        # (learner.py _rng / GBDT.bagging) so fused and per-iteration
-        # paths stay bit-identical
+        # fused bagging, quantization rounding): seeds mirror the host
+        # learner's derivation (learner.py _rng / GBDT.bagging) so fused
+        # and per-iteration paths stay bit-identical
         self._ff_frac = float(config.feature_fraction)
-        nf = int(dataset.num_features)
+        nf = self.num_features
         self._ff_nf = nf
         self._ff_k = max(1, int(np.ceil(nf * self._ff_frac)))
         self._ff_seed = int(config.feature_fraction_seed
@@ -239,8 +293,8 @@ class DeviceGrower:
         self._bag_fraction = float(config.bagging_fraction)
         self._bag_freq = int(config.bagging_freq)
         self._bag_seed = int(config.bagging_seed) & 0x7FFFFFFF
-        from .histogram import bucket_size
         self._bag_npad = bucket_size(max(self.num_data, 1))
+        self._quant_seed = (int(config.seed) + 5) & 0x7FFFFFFF
 
     # ------------------------------------------------------------------
     def feature_mask_for(self, tree_idx):
@@ -255,10 +309,12 @@ class DeviceGrower:
     # ------------------------------------------------------------------
     # wave histogram: one dense pass for up to W pending leaves
     # ------------------------------------------------------------------
-    def _wave_hist(self, binned, leaf_id, ghk, pending):
-        """(n_pad,) leaf ids, (n_pad, K) bf16 stat columns (K=3:
-        [g,h,1]; K=5: [g_hi,g_lo,h_hi,h_lo,1]), (W,) pending leaf ids
-        (-1 = empty slot) -> (W, S, 3) f32.
+    def _wave_hist(self, binned, leaf_id, ghk, pending, scales=None):
+        """(n_pad,) leaf ids, (n_pad, K) stat columns (bf16 — K=3:
+        [g,h,1]; K=5: [g_hi,g_lo,h_hi,h_lo,1] — or int8 under
+        grad_quant_bits), (W,) pending leaf ids (-1 = empty slot)
+        -> (W, S, 3) f32.  ``scales`` is the (2,) [scale_g, scale_h]
+        dequantization vector (quantized mode only).
 
         The one-hot must stay a bare iota-compare so XLA fuses its
         generation into the dot operand (a multi-hot built as
@@ -286,10 +342,13 @@ class DeviceGrower:
         binned_c = binned.reshape(n_chunks, ch, g)
         leaf_c = leaf_id.reshape(n_chunks, ch)
         ghk_c = ghk.reshape(n_chunks, ch, k)
+        quant = bool(self.quant_bits)
+        mdtype = jnp.int8 if quant else jnp.bfloat16
+        adtype = jnp.int32 if quant else jnp.float32
 
         def body(acc, xs):
             b, l, gk = xs
-            lm = (l[:, None] == pending[None, :]).astype(jnp.bfloat16)
+            lm = (l[:, None] == pending[None, :]).astype(mdtype)
             bmat = (lm[:, :, None] * gk[:, None, :]).reshape(ch, w * k)
             # bin tiling: a one-hot wider than 64 breaks XLA's
             # operand fusion (max_bin=255 measured 10x the max_bin=63
@@ -300,22 +359,81 @@ class DeviceGrower:
             outs = []
             for off in range(0, nb, 64):
                 oh = jax.nn.one_hot(bi - off, min(nb, 64),
-                                    dtype=jnp.bfloat16)        # (CH,G,64)
+                                    dtype=mdtype)               # (CH,G,64)
                 outs.append(jnp.einsum("cgn,cb->gnb", oh, bmat,
-                                       preferred_element_type=jnp.float32))
+                                       preferred_element_type=adtype))
             out = outs[0] if len(outs) == 1 \
                 else jnp.concatenate(outs, axis=1)
             return acc + out, None
 
-        acc0 = jnp.zeros((g, nb, w * k), jnp.float32)
+        acc0 = jnp.zeros((g, nb, w * k), adtype)
         acc, _ = jax.lax.scan(body, acc0, (binned_c, leaf_c, ghk_c))
         acc = acc.reshape(g, nb, w, k)
-        hist = _combine_hist_cols(acc, k)                        # (G,NB,W,3)
+        if quant:
+            # dequantize ONCE per histogram: integer bin sums scaled
+            # back to f32 before any gain math.  Striped g/h stripes are
+            # cast to f32 BEFORE summing — each stripe is int32-exact
+            # (< 127 * 2^24), but their int32 SUM can wrap for a bin
+            # holding > 2^31/127 rows (hess == 1.0 quantizes to 127
+            # everywhere); the f32 cast costs <= 2^-24 relative, far
+            # below the rounding noise.  Count stripes sum in int32
+            # (2 * 2^24 * 1 cannot overflow), so counts stay exact up to
+            # f32's integer range like the bf16 striped layout.
+            f32 = lambda a: a.astype(jnp.float32)
+            if k == 6:
+                gsum = f32(acc[..., 0]) + f32(acc[..., 1])
+                hsum = f32(acc[..., 2]) + f32(acc[..., 3])
+                cnt = f32(acc[..., 4] + acc[..., 5])
+            else:
+                gsum, hsum, cnt = (f32(acc[..., 0]), f32(acc[..., 1]),
+                                   f32(acc[..., 2]))
+            hist = jnp.stack([gsum * scales[0], hsum * scales[1], cnt],
+                             axis=-1)
+        else:
+            hist = _combine_hist_cols(acc, k)                    # (G,NB,W,3)
         return hist.transpose(2, 0, 1, 3).reshape(w, self.num_slots, 3)
 
     # ------------------------------------------------------------------
-    def _leaf_output(self, g, h):
-        hp = self.hyper
+    def _stat_columns(self, grad, hess, one_f, tree_idx):
+        """(n_pad, K) wave stat columns + (2,) dequantization scales
+        (zeros when quantization is off).  ``one_f`` is the f32 0/1 row
+        indicator (valid-row mask x bagging mask).  The ONE assembly
+        shared by the production grow program and the profiling probes,
+        so probes time exactly the operand pipeline training runs."""
+        n = one_f.shape[0]
+        k = self.hist_cols
+        if self.quant_bits:
+            qkey = jax.random.fold_in(
+                jax.random.PRNGKey(self._quant_seed), tree_idx)
+            sg, sh, gq, hq = quantize_gh(grad, hess, qkey)
+            m8 = one_f.astype(jnp.int8)
+            if k == 6:
+                # striped g/h/count columns: each stripe's int32
+                # accumulation stays exact below 127 * 2^24
+                s8 = (jnp.arange(n) < (n // 2)).astype(jnp.int8)
+                t8 = (1 - s8).astype(jnp.int8)
+                gcols = [gq * m8 * s8, gq * m8 * t8, hq * m8 * s8,
+                         hq * m8 * t8, m8 * s8, m8 * t8]
+            else:
+                gcols = [gq * m8, hq * m8, m8]
+            return jnp.stack(gcols, 1), jnp.stack([sg, sh])
+        one = one_f.astype(jnp.bfloat16)
+        if k in (5, 6):
+            gcols = _hi_lo_cols(grad, hess, one)
+        else:
+            gcols = [grad.astype(jnp.bfloat16) * one,
+                     hess.astype(jnp.bfloat16) * one]
+        if k in (4, 6):
+            # two striped count columns (< 2^24 rows each) keep counts
+            # integer-exact beyond the single-column f32 limit
+            stripe = (jnp.arange(n) < (n // 2)).astype(jnp.bfloat16)
+            gcols += [one * stripe, one * (1.0 - stripe)]
+        else:
+            gcols += [one]
+        return jnp.stack(gcols, 1), jnp.zeros((2,), jnp.float32)
+
+    # ------------------------------------------------------------------
+    def _leaf_output(self, g, h, hp):
         s = jnp.sign(g) * jnp.maximum(jnp.abs(g) - hp.lambda_l1, 0.0)
         out = -s / (h + hp.lambda_l2 + 1e-35)
         clipped = jnp.clip(out, -hp.max_delta_step, hp.max_delta_step)
@@ -331,14 +449,19 @@ class DeviceGrower:
 
     # ------------------------------------------------------------------
     def _grow_impl(self, binned, binned_t, score, grad, hess, feature_mask,
-                   lr, row_mask, *, with_mask):
+                   lr, row_mask, tree_idx, meta, hyper, tables, *,
+                   with_mask):
         """One boosting iteration on device.  Returns (new_score, rec_i
-        (L-1,5) i32, rec_f (L-1,9) f32, num_leaves i32, root_value f32).
+        (L-1,5) i32, rec_f (L-1,9) f32, rec_c (L-1,8) i32, num_leaves
+        i32, root_value f32, num_waves i32, quant_scales (2,) f32).
         ``lr`` is traced so callbacks may reset the learning rate without
-        recompiling.  The binned matrices are arguments, not closures: a
-        closed-over array becomes an XLA constant and ships inside the
-        compile request (fatal at 10M-row scale on a remote-compile
-        backend)."""
+        recompiling; ``tree_idx`` is the global tree index keying the
+        quantization rounding noise (unused when grad_quant_bits=0).
+        The binned matrices — like ``meta``/``hyper``/``tables`` — are
+        arguments, not closures: a closed-over array becomes an XLA
+        constant and ships inside the compile request (fatal at 10M-row
+        scale on a remote-compile backend), and argument-passing is what
+        lets the program cache serve every same-shaped dataset."""
         L, W, S = self.num_leaves, self.wave_width, self.num_slots
         n = self.n_pad
         npad_rows = n - self.num_data
@@ -353,24 +476,8 @@ class DeviceGrower:
             # update reaches them - the reference's OOB traversal update
             # (gbdt.cpp:451-471) falls out for free.
             one_f = one_f * jnp.pad(row_mask, (0, npad_rows))
-        one = one_f.astype(jnp.bfloat16)
-        ghi = grad.astype(jnp.bfloat16)
-        hhi = hess.astype(jnp.bfloat16)
-        k = self.hist_cols
-        if k in (5, 6):
-            glo = (grad - ghi.astype(jnp.float32)).astype(jnp.bfloat16)
-            hlo = (hess - hhi.astype(jnp.float32)).astype(jnp.bfloat16)
-            gcols = [ghi * one, glo * one, hhi * one, hlo * one]
-        else:
-            gcols = [ghi * one, hhi * one]
-        if k in (4, 6):
-            # two striped count columns (< 2^24 rows each) keep counts
-            # integer-exact beyond the single-column f32 limit
-            stripe = (jnp.arange(n) < (n // 2)).astype(jnp.bfloat16)
-            gcols += [one * stripe, one * (1.0 - stripe)]
-        else:
-            gcols += [one]
-        gh5 = jnp.stack(gcols, 1)
+        gh5, qscales = self._stat_columns(grad, hess, one_f, tree_idx)
+        wave_scales = qscales if self.quant_bits else None
 
         leaf_id0 = jnp.where(jnp.arange(n, dtype=jnp.int32) < self.num_data,
                              0, -1)
@@ -419,10 +526,9 @@ class DeviceGrower:
             p_large=jnp.full((W0,), -1, jnp.int32),
         )
 
-        has_cat = bool(np.asarray(
-            self.dataset.f_is_categorical).any())
-        find_one = functools.partial(find_best_split_impl, meta=self.meta,
-                                     hp=self.hyper, has_cat=has_cat)
+        has_cat = self.has_cat
+        find_one = functools.partial(find_best_split_impl, meta=meta,
+                                     hp=hyper, has_cat=has_cat)
 
         def evaluate(hists, totals, ids, depths, feature_mask):
             """vmapped find-best over fresh leaves; gated by splittability.
@@ -439,7 +545,7 @@ class DeviceGrower:
           def wave(st: _S) -> _S:
             # 1. fresh histograms for pending smaller children
             fresh = self._wave_hist(binned, st.leaf_id, gh5,
-                                    st.p_small)               # (W,S,3)
+                                    st.p_small, wave_scales)  # (W,S,3)
             root_wave = st.p_parent[0] < 0
             # root total from group-0 slot sums (every row hits one slot)
             root_total = fresh[0, :self.nb, :].sum(0)
@@ -463,7 +569,7 @@ class DeviceGrower:
             value = jnp.where(
                 root_wave,
                 st.value.at[0].set(self._leaf_output(total[0, 0],
-                                                     total[0, 1])),
+                                                     total[0, 1], hyper)),
                 st.value)
 
             # 3. find-best for the new leaves (both siblings); reuse the
@@ -497,12 +603,12 @@ class DeviceGrower:
             f = vecs[:, F_FEATURE].astype(jnp.int32)
             thr = vecs[:, F_THRESHOLD].astype(jnp.int32)
             dl = vecs[:, F_DEFAULT_LEFT] > 0.5
-            grp = self.p_group[f]
-            off = self.p_offset[f]
-            wid = self.p_width[f]
-            db = self.p_default_bin[f]
-            nbin = self.p_num_bin[f]
-            miss = self.p_missing[f]
+            grp = tables.group[f]
+            off = tables.offset[f]
+            wid = tables.width[f]
+            db = meta.default_bin[f]
+            nbin = meta.num_bin[f]
+            miss = meta.missing[f]
             def_left = jnp.where(miss == 1, dl, db <= thr)    # (W,)
 
             # leaf_id update: ONE fused vectorized pass over the W
@@ -614,7 +720,8 @@ class DeviceGrower:
         # columns regardless of how many are live).  Growing the width
         # with the frontier cuts the early waves' cost ~5-10x; each stage
         # is its own while_loop over the same state with the pending
-        # arrays padded to the next width.
+        # arrays padded to the next width.  The plan comes from
+        # ops/stage_plan.py (byte-stable default or profile-derived).
         def resize(st: _S, w_to: int) -> _S:
             pad = w_to - st.p_parent.shape[0]
             if pad <= 0:
@@ -635,12 +742,58 @@ class DeviceGrower:
                 make_wave(ws), st)
         final = st
         leaf_final = final.leaf_id
+        rec_f_out = final.rec_f
+
+        if self.quant_bits:
+            # f32 leaf-value REFIT (Shi et al. §4.3): tree STRUCTURE came
+            # from quantized histograms, but each final leaf's value is
+            # recomputed from the full-precision gradients with one
+            # hi/lo-bf16 one-hot contraction (same cost class as the
+            # score update), then written back into the split records so
+            # host-materialized trees match the device score update.
+            one_b = one_f.astype(jnp.bfloat16)
+            cols4 = jnp.stack(_hi_lo_cols(grad, hess, one_b), 1)  # (n, 4)
+            ohl = jax.nn.one_hot(leaf_final, L, dtype=jnp.bfloat16)
+            sums = jnp.einsum("nl,nk->lk", ohl, cols4,
+                              preferred_element_type=jnp.float32)
+            refit = self._leaf_output(sums[:, 0] + sums[:, 1],
+                                      sums[:, 2] + sums[:, 3], hyper)
+            exists = jnp.arange(L, dtype=jnp.int32) < final.nl
+            # each final leaf's value lives in its CREATING record (the
+            # last record mentioning the leaf id: left children keep the
+            # parent's id, right ids are fresh); segment-max over the
+            # record index finds it without a host loop
+            recs_r = jnp.arange(L, dtype=jnp.int32)
+            lid, rid = final.rec_i[:, 0], final.rec_i[:, 1]
+            base = jnp.full((L + 1,), -1, jnp.int32)
+            last_l = base.at[jnp.where(lid >= 0, lid, L)].max(recs_r)
+            last_r = base.at[jnp.where(rid >= 0, rid, L)].max(recs_r)
+            crec = jnp.maximum(last_l[:L], last_r[:L])
+            is_left = last_l[:L] >= last_r[:L]
+            do = exists & (crec >= 0)
+            if self.has_cat:
+                # leaves created by a categorical split keep their
+                # growth value: sorted-mode cat splits regularize with
+                # lambda_l2 + cat_l2 (split.py pack_best use_l2), which
+                # the plain-lambda_l2 refit formula would drop —
+                # under-regularizing exactly those leaves
+                cfeat = final.rec_i[jnp.where(do, crec, 0), 2]
+                from_cat = do & (meta.is_cat[jnp.clip(cfeat, 0, None)]
+                                 == 1)
+                refit = jnp.where(from_cat, final.value[:L], refit)
+            leaf_vals = jnp.where(exists, refit, 0.0)
+            rows = jnp.where(do, crec, L - 1)        # junk record row
+            cols_i = jnp.where(is_left, REC_F_LEFT_OUT, REC_F_RIGHT_OUT)
+            rec_f_out = rec_f_out.at[rows, cols_i].set(
+                jnp.where(do, leaf_vals, rec_f_out[rows, cols_i]))
+        else:
+            leaf_vals = final.value[:L]
 
         # score update: score[row] += lr * value[leaf_id[row]] via one-hot
         # matmul (hi/lo split keeps f32-level precision at bf16 speed).
         # A stump (root never split) applies nothing: the boosting driver
         # treats it as the stop signal, matching GBDT::TrainOneIter.
-        scaled = final.value[:L] * lr * (final.nl > 1)
+        scaled = leaf_vals * lr * (final.nl > 1)
         vhi = scaled.astype(jnp.bfloat16)
         vlo = (scaled - vhi.astype(jnp.float32)).astype(jnp.bfloat16)
         vmat = jnp.stack([vhi, vlo], 1)                       # (L, 2)
@@ -650,29 +803,9 @@ class DeviceGrower:
         new_score = score + (upd[:, 0] + upd[:, 1])[:self.num_data]
 
         return (new_score, final.rec_i[:max(L - 1, 1)],
-                final.rec_f[:max(L - 1, 1)],
+                rec_f_out[:max(L - 1, 1)],
                 final.rec_c[:max(L - 1, 1)], final.nl, final.value[0],
-                final.waves)
-
-    # ------------------------------------------------------------------
-    def grow_one_iter(self, score, grad, hess, feature_mask, lr=None,
-                      row_mask=None):
-        """Dispatch one boosting iteration; returns device handles
-        (new_score, rec_i, rec_f, rec_c, num_leaves, root_value,
-        num_waves) without blocking.  ``row_mask`` is an optional (N,)
-        f32 0/1 in-bag indicator (bagging / GOSS)."""
-        if lr is None:
-            lr = self.lr
-        obs.inc("grow.dispatches")
-        if row_mask is None:
-            return self._grow(self.binned, self.binned_t, score, grad,
-                              hess, feature_mask,
-                              jnp.asarray(lr, jnp.float32),
-                              jnp.zeros((0,), jnp.float32))
-        return self._grow_masked(self.binned, self.binned_t, score, grad,
-                                 hess, feature_mask,
-                                 jnp.asarray(lr, jnp.float32), row_mask)
-
+                final.waves, qscales)
 
     # ------------------------------------------------------------------
     def fused_train(self, length: int):
@@ -689,18 +822,20 @@ class DeviceGrower:
         track device throughput.
 
         Sampling lives INSIDE the scan: the per-tree feature_fraction
-        mask is ``fold_in(key, tree_idx)`` and the bagging row mask is
+        mask is ``fold_in(key, tree_idx)``, the bagging row mask is
         re-drawn every ``bagging_freq`` trees with the per-iteration
-        path's exact ``(bagging_seed + it)`` seeding, so the fork
-        harness's ``feature_fraction=0.8, bagging_freq=5`` config fuses
-        and still emits bit-identical trees (tests/test_fused.py).
+        path's exact ``(bagging_seed + it)`` seeding, and the int8
+        quantization noise is keyed by the same global tree index — so
+        fused and per-iteration emit bit-identical trees even with
+        quantization on (tests/test_fused.py, tests/test_quant.py).
 
-        Signature of the returned program::
+        Signature of the returned (raw) program::
 
-            run(binned, binned_t, score, lr, gargs, it0, grad_fn=fn)
+            run(binned, binned_t, score, lr, gargs, it0,
+                meta, hyper, tables, grad_fn=fn)
             -> (final_score,
                 (rec_i (K,L-1,5), rec_f (K,L-1,9), rec_c (K,L-1,8),
-                 nl (K,), root_value (K,), waves (K,)))
+                 nl (K,), root_value (K,), waves (K,), qscales (K,2)))
 
         ``it0`` is the global iteration index of the chunk's first tree
         (traced, so resuming mid-run reuses the compiled program).
@@ -708,13 +843,20 @@ class DeviceGrower:
         ``ObjectiveFunction.device_grad`` (pure jnp; all arrays via
         ``gargs``).  Compiled once per (length, grad_fn) pair — callers
         must reuse one grad_fn instance to hit the jit cache.
+        ``DeviceGrower.fused_train`` wraps this with the grower's own
+        meta/hyper/tables so boosting-layer call sites stay unchanged.
         """
+        with self._fused_lock:
+            return self._fused_program(length)
+
+    def _fused_program(self, length: int):
         if length not in self._fused:
             use_bag = self._bag_fraction < 1.0 and self._bag_freq > 0
             bag_freq, bag_seed = self._bag_freq, self._bag_seed
             bag_frac, bag_npad = self._bag_fraction, self._bag_npad
 
-            def run(binned, binned_t, score, lr, gargs, it0, grad_fn):
+            def run(binned, binned_t, score, lr, gargs, it0, meta, hyper,
+                    tables, grad_fn):
                 no_mask = jnp.zeros((0,), jnp.float32)
                 its = jnp.arange(length, dtype=jnp.int32) + it0
 
@@ -734,12 +876,12 @@ class DeviceGrower:
                         bmask = jax.lax.cond(it % bag_freq == 0,
                                              lambda: draw_bag(it),
                                              lambda: bmask)
-                    (new_score, rec_i, rec_f, rec_c, nl, root, waves) = \
-                        self._grow_impl(binned, binned_t, sc, g, h,
-                                        fmask, lr,
-                                        bmask if use_bag else no_mask,
-                                        with_mask=use_bag)
-                    out = (rec_i, rec_f, rec_c, nl, root, waves)
+                    (new_score, rec_i, rec_f, rec_c, nl, root, waves,
+                     qs) = self._grow_impl(
+                        binned, binned_t, sc, g, h, fmask, lr,
+                        bmask if use_bag else no_mask, it, meta, hyper,
+                        tables, with_mask=use_bag)
+                    out = (rec_i, rec_f, rec_c, nl, root, waves, qs)
                     return ((new_score, bmask) if use_bag
                             else new_score), out
 
@@ -757,6 +899,290 @@ class DeviceGrower:
                 "fused_train", jax.jit(run, static_argnames=("grad_fn",)),
                 static_info=(f"len={length}",))
         return self._fused[length]
+
+
+# ---------------------------------------------------------------------------
+# process-level program cache: the expensive artifact of a DeviceGrower is
+# its jitted (traced + compiled) programs, and nothing in them depends on
+# the DATA — only on shapes, bin-structure flags and config.  Sharing them
+# across grower instances removes the per-window re-trace cost of the
+# retrain-every-window harness (ROUND6_NOTES "still open" item).
+# ---------------------------------------------------------------------------
+_PROGRAM_CACHE: "OrderedDict[tuple, GrowerPrograms]" = OrderedDict()
+_PROGRAM_CACHE_LOCK = threading.Lock()
+_PROGRAM_CACHE_MAX = 8
+
+
+# params that never shape a trace, so they must stay out of the
+# signature: wave_plan/grower_cache only steer host-side plan resolution
+# and caching (keying on them would stop a wave_plan=auto run from
+# picking up a profiled run's cached plan — the plan itself is keyed
+# separately via its digest), and learning_rate is a traced argument
+# (so callbacks may decay it without forcing a program-cache miss)
+_NON_TRACE_PARAMS = ("wave_plan", "grower_cache", "learning_rate")
+
+
+def _config_digest(config) -> str:
+    items = sorted((k, repr(v)) for k, v in config.to_dict().items()
+                   if k not in _NON_TRACE_PARAMS)
+    return hashlib.sha1(repr(items).encode()).hexdigest()
+
+
+def programs_signature(num_data: int, num_groups: int, nb: int,
+                       num_features: int, has_cat: bool, config) -> tuple:
+    """Everything a GrowerPrograms trace depends on besides the stage
+    plan: array shapes, bin-structure flags, module tunables and the
+    full config (hashed — over-keying only costs cache hits, never
+    correctness)."""
+    return (num_data, num_groups, nb, num_features, bool(has_cat),
+            _CHUNK, COUNT_SPLIT_ROWS, _config_digest(config))
+
+
+def get_grower_programs(num_data: int, num_groups: int, nb: int,
+                        num_features: int, has_cat: bool, config,
+                        plan: Optional[list] = None,
+                        plan_source: str = "default") -> GrowerPrograms:
+    """Fetch (or build) the shared programs for this signature.  When no
+    explicit plan is given, a profile-derived plan cached for the same
+    signature (``DeviceGrower.profile_stage_plan``) is picked up under
+    ``wave_plan=auto``/``profiled``."""
+    base = programs_signature(num_data, num_groups, nb, num_features,
+                              has_cat, config)
+    if plan is None and str(getattr(config, "wave_plan", "auto")).lower() \
+            in ("auto", "profiled"):
+        cached = stage_plan_mod.cached_plan(base)
+        if cached is not None:
+            plan, plan_source = cached, "profiled"
+    if plan is None:
+        plan = default_stage_plan(num_data, config)
+    pd = stage_plan_mod.plan_digest(plan)
+    build = functools.partial(
+        GrowerPrograms, num_data=num_data, num_groups=num_groups, nb=nb,
+        num_features=num_features, has_cat=has_cat, config=config,
+        plan=plan, plan_source=plan_source)
+    if not bool(getattr(config, "grower_cache", True)):
+        return build()
+    key = base + (pd,)
+    with _PROGRAM_CACHE_LOCK:
+        progs = _PROGRAM_CACHE.get(key)
+        if progs is not None:
+            _PROGRAM_CACHE.move_to_end(key)
+            if plan_source == "profiled":
+                # the profiled plan can coincide with the plan a cached
+                # entry was built under (same digest => same key); the
+                # plan is now measurement-confirmed either way
+                progs.plan_source = "profiled"
+            obs.inc("grow.cache_hits")
+            return progs
+        obs.inc("grow.cache_misses")
+        progs = build()
+        _PROGRAM_CACHE[key] = progs
+        while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_MAX:
+            _PROGRAM_CACHE.popitem(last=False)
+        return progs
+
+
+class DeviceGrower:
+    """Grows whole trees on device; one dispatch per boosting iteration.
+
+    Parameters mirror the serial learner's (dataset, config) pair.  The
+    instance owns the device copies of the binned matrix in both layouts
+    plus the per-dataset metadata arrays; the jitted programs come from
+    the shared process-level cache (:func:`get_grower_programs`) and are
+    reached through attribute forwarding, so ``grower.hist_cols`` etc.
+    keep working."""
+
+    def __init__(self, dataset, config):
+        self.config = config
+        self.dataset = dataset
+        self.num_data = int(dataset.num_data)
+
+        # per-group slot pitch: smallest power of two covering every group
+        nb = 64
+        for g in dataset.groups:
+            while g.num_total_bin > nb:
+                nb *= 2
+
+        has_cat = bool(np.asarray(dataset.f_is_categorical).any())
+        self.programs = get_grower_programs(
+            self.num_data, int(dataset.num_groups), nb,
+            int(dataset.num_features), has_cat, config)
+        self._base_signature = programs_signature(
+            self.num_data, int(dataset.num_groups), nb,
+            int(dataset.num_features), has_cat, config)
+
+        pad = self.programs.n_pad - self.num_data
+        if getattr(dataset, "device_binned", False):
+            # matrix already lives in HBM (construct_from_device_matrix)
+            binned_d = dataset.binned
+            if pad:
+                binned_d = jnp.pad(binned_d, ((0, pad), (0, 0)))
+            self.binned = binned_d
+        else:
+            binned = np.asarray(dataset.binned)  # (N, G) uint8
+            if pad:
+                binned = np.pad(binned, ((0, pad), (0, 0)))
+            self.binned = jnp.asarray(binned)
+        # the (G, N) copy is a device-side transpose: uploading it
+        # separately doubled the host->device transfer and the host
+        # ascontiguousarray pass (~seconds at 10M rows)
+        self.binned_t = jnp.transpose(self.binned)
+
+        self.meta = FeatureMeta.from_dataset(dataset, slot_stride=nb)
+        self.hyper = SplitHyper.from_config(config)
+        self.tables = FTables.from_dataset(dataset)
+        self.lr = float(config.learning_rate)
+
+    # programs hold every static/trace-level attribute (hist_cols,
+    # wave_width, stage_plan, nb, n_pad, quant_bits, feature_mask_for,
+    # _wave_hist, ...); forward reads so call sites and tests are
+    # agnostic to where an attribute lives
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "programs"), name)
+
+    def __setattr__(self, name, value):
+        # a write to a programs-owned attribute would create a shadowing
+        # instance attribute: reads would show the new value while the
+        # programs (which the jitted code consults) keep the old one —
+        # the silent no-op failure mode of the pre-refactor pattern
+        # `grower.use_pallas = True`.  Fail loudly instead; mutate
+        # `grower.programs.<attr>` explicitly (with grower_cache=false
+        # for a private, non-process-shared instance).
+        progs = self.__dict__.get("programs")
+        if (progs is not None and name not in self.__dict__
+                and hasattr(progs, name)):
+            raise AttributeError(
+                f"'{name}' lives on the shared GrowerPrograms object; "
+                f"set grower.programs.{name} explicitly (and pass "
+                f"grower_cache=false for a private instance)")
+        object.__setattr__(self, name, value)
+
+    # ------------------------------------------------------------------
+    def grow_one_iter(self, score, grad, hess, feature_mask, lr=None,
+                      row_mask=None, tree_idx=0):
+        """Dispatch one boosting iteration; returns device handles
+        (new_score, rec_i, rec_f, rec_c, num_leaves, root_value,
+        num_waves, quant_scales) without blocking.  ``row_mask`` is an
+        optional (N,) f32 0/1 in-bag indicator (bagging / GOSS);
+        ``tree_idx`` is the global tree index keying the per-tree
+        quantization rounding noise."""
+        if lr is None:
+            lr = self.lr
+        obs.inc("grow.dispatches")
+        ti = jnp.asarray(tree_idx, jnp.int32)
+        if row_mask is None:
+            return self.programs._grow(
+                self.binned, self.binned_t, score, grad, hess,
+                feature_mask, jnp.asarray(lr, jnp.float32),
+                jnp.zeros((0,), jnp.float32), ti, self.meta, self.hyper,
+                self.tables)
+        return self.programs._grow_masked(
+            self.binned, self.binned_t, score, grad, hess, feature_mask,
+            jnp.asarray(lr, jnp.float32), row_mask, ti, self.meta,
+            self.hyper, self.tables)
+
+    # ------------------------------------------------------------------
+    def fused_train(self, length: int):
+        """Multi-iteration fused program with this grower's metadata
+        bound; same call contract the boosting layer always used::
+
+            run(binned, binned_t, score, lr, gargs, it0, grad_fn=fn)
+        """
+        raw = self.programs.fused_train(length)
+        meta, hyper, tables = self.meta, self.hyper, self.tables
+
+        def run(binned, binned_t, score, lr, gargs, it0, grad_fn):
+            return raw(binned, binned_t, score, lr, gargs, it0, meta,
+                       hyper, tables, grad_fn=grad_fn)
+        return run
+
+    # ------------------------------------------------------------------
+    def profile_stage_plan(self, reps: int = 3, install: bool = True):
+        """Time the wave histogram at every candidate stage width on the
+        REAL binned matrix, record the per-stage timings through the obs
+        layer (``grow.stage.w<W>`` spans + gauges), fit the
+        fixed-vs-per-column cost model and derive the cheapest stage
+        plan (ops/stage_plan.py).  ``install=True`` caches the plan
+        under this grower's (shape, config) signature — later growers
+        with the same signature pick it up automatically — and swaps
+        this grower onto programs built for the new plan.
+
+        Returns ``{"stage_ms", "fixed_ms", "col_ms", "plan",
+        "plan_digest", "installed"}``."""
+        import time as _time
+
+        reps = max(1, int(reps))
+        progs = self.programs
+        if install and progs.plan_source == "profiled":
+            # already measured for this signature in this process
+            return {"stage_ms": {}, "fixed_ms": None, "col_ms": None,
+                    "plan": list(progs.stage_plan),
+                    "plan_digest":
+                        stage_plan_mod.plan_digest(progs.stage_plan),
+                    "installed": False}
+        k = progs.hist_cols
+        n = progs.n_pad
+        rng = np.random.default_rng(0)
+        grad = jnp.asarray(rng.standard_normal(n, dtype=np.float32))
+        hess = jnp.abs(grad) + 0.1
+        widths = sorted({w for w, _ in progs.stage_plan}
+                        | set(stage_plan_mod._ladder(progs.wave_width))
+                        | {progs.wave_width})
+        stage_ms = {}
+        # the REAL operand pipeline (incl. quantization when on), so the
+        # probes time exactly what training dispatches
+        ghk, scales = progs._stat_columns(grad, hess,
+                                          jnp.ones((n,), jnp.float32), 0)
+        wave_scales = scales if progs.quant_bits else None
+
+        def probe_for(w):
+            leaf = jnp.asarray(rng.integers(0, w, n).astype(np.int32))
+            pend = jnp.arange(w, dtype=jnp.int32)
+            fn = obs.track_jit(
+                f"stage_probe_w{w}",
+                jax.jit(lambda b, l, g2, p:
+                        progs._wave_hist(b, l, g2, p, wave_scales)))
+            return fn, leaf, ghk, pend
+
+        for w in widths:
+            fn, leaf, ghk, pend = probe_for(w)
+            jax.block_until_ready(fn(self.binned, leaf, ghk, pend))
+            with obs.span("grow.stage_probe", cat="grow", width=w,
+                          hist_cols=k):
+                t0 = _time.perf_counter()
+                for _ in range(reps):
+                    r = fn(self.binned, leaf, ghk, pend)
+                jax.block_until_ready(r)
+                ms = (_time.perf_counter() - t0) / reps * 1e3
+            stage_ms[w] = round(ms, 3)
+            obs.observe(f"grow.stage.w{w}", ms / 1e3)
+            obs.set_gauge(f"grow.stage.w{w}_ms", round(ms, 3))
+        fixed, col = stage_plan_mod.fit_wave_costs(
+            widths, [stage_ms[w] for w in widths], k,
+            num_data=progs.num_data)
+        plan = stage_plan_mod.derive_stage_plan(
+            progs.num_leaves, progs.wave_width, k, fixed, col,
+            measured_ms=stage_ms)
+        obs.set_gauge("grow.stage.fixed_ms", round(fixed, 3))
+        obs.set_gauge("grow.stage.col_ms", round(col, 5))
+        installed = False
+        if install:
+            stage_plan_mod.cache_plan(self._base_signature, plan)
+            if plan != progs.stage_plan:
+                self.programs = get_grower_programs(
+                    progs.num_data, progs.num_groups, progs.nb,
+                    progs.num_features, progs.has_cat, self.config,
+                    plan=plan, plan_source="profiled")
+                installed = True
+            else:
+                # derived plan == current plan: nothing to rebuild, but
+                # the plan is now measurement-confirmed (keeps the
+                # early-exit above from re-probing this signature)
+                progs.plan_source = "profiled"
+        return {"stage_ms": stage_ms, "fixed_ms": round(fixed, 3),
+                "col_ms": round(col, 5), "plan": plan,
+                "plan_digest": stage_plan_mod.plan_digest(plan),
+                "installed": installed}
 
     # ------------------------------------------------------------------
     def profile_phases(self, grad, hess, reps: int = 20) -> dict:
@@ -779,26 +1205,16 @@ class DeviceGrower:
         grad = jnp.pad(grad, (0, n - self.num_data))
         hess = jnp.pad(hess, (0, n - self.num_data))
 
-        k = self.hist_cols
+        quant = bool(self.quant_bits)
 
         @jax.jit
         def p_hist(binned, leaf, g, h, pend):
-            one = jnp.ones((n,), jnp.bfloat16)
-            ghi = g.astype(jnp.bfloat16)
-            hhi = h.astype(jnp.bfloat16)
-            if k in (5, 6):
-                glo = (g - ghi.astype(jnp.float32)).astype(jnp.bfloat16)
-                hlo = (h - hhi.astype(jnp.float32)).astype(jnp.bfloat16)
-                cols = [ghi, glo, hhi, hlo]
-            else:
-                cols = [ghi, hhi]
-            if k in (4, 6):
-                stripe = (jnp.arange(n) < (n // 2)).astype(jnp.bfloat16)
-                cols += [stripe, 1.0 - stripe]
-            else:
-                cols += [one]
-            ghk = jnp.stack(cols, 1)
-            return self._wave_hist(binned, leaf, ghk, pend)
+            # the real operand pipeline (shared _stat_columns), so the
+            # profiled wave_hist matches production bit-for-bit
+            ghk, scales = self.programs._stat_columns(
+                g, h, jnp.ones((n,), jnp.float32), 0)
+            return self.programs._wave_hist(binned, leaf, ghk, pend,
+                                            scales if quant else None)
 
         @jax.jit
         def p_find(hists, feature_mask):
@@ -830,7 +1246,7 @@ class DeviceGrower:
                              preferred_element_type=jnp.float32)
             return score + upd[:, 0] + upd[:, 1]
 
-        mask = jnp.ones((len(np.asarray(self.p_group)),), bool)
+        mask = jnp.ones((self.num_features,), bool)
         grp = jnp.asarray(rng.integers(0, self.num_groups, w, np.int32))
         thr = jnp.asarray(rng.integers(0, self.nb, w, np.int32))
         rdel = jnp.asarray(rng.integers(1, w + 1, w, np.int32))
@@ -887,6 +1303,7 @@ def device_growth_eligible(config, dataset, objective, num_model) -> bool:
         return False
     # single f32 count columns are exact below COUNT_SPLIT_ROWS (2^24);
     # the striped two-column layout extends that to twice the threshold
+    # (the int8 path's striped int32 g/h accumulators share the bound)
     if dataset.num_data >= 2 * COUNT_SPLIT_ROWS:
         return False
     return True

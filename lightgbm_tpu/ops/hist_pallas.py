@@ -168,7 +168,16 @@ def wave_hist_pallas(binned, leaf_id, ghk, pending, *, g: int, nb: int,
                      k: int, w: int, ch: int = 1024,
                      interpret: bool = False):
     """(n_pad, G) u8 bins, (n_pad,) i32 leaf ids, (n_pad, K) bf16 stat
-    columns, (W,) i32 pending -> (G*NB, K, W) f32 histogram."""
+    columns, (W,) i32 pending -> (G*NB, K, W) f32 histogram.
+
+    bf16-only: the int8 quantized gradient path (grad_quant_bits=8)
+    stays on the XLA einsum, whose int8->int32 contraction already hits
+    the MXU's native path — a VMEM variant would need an int32
+    accumulator layout this kernel does not implement."""
+    if ghk.dtype != jnp.bfloat16:
+        raise ValueError(
+            f"pallas wave-histogram supports bf16 stat columns only, "
+            f"got {ghk.dtype} (grad_quant_bits routes to the einsum)")
     n = binned.shape[0]
     if n % ch:
         raise ValueError(

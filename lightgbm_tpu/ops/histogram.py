@@ -26,6 +26,42 @@ import jax.numpy as jnp
 # rows per scan chunk: 8 MXU passes of 1024x256 per group keeps VMEM happy
 _CHUNK = 8192
 
+# int8 quantization range for grad_quant_bits=8: symmetric [-127, 127]
+# (the -128 code is unused so negation stays exact)
+QUANT_MAX = 127.0
+
+
+def quant_scales(grad, hess, eps: float = 1e-30):
+    """Per-dispatch global scales mapping max|g| / max|h| onto the int8
+    range (Shi et al., *Quantized Training of Gradient Boosting Decision
+    Trees*, NeurIPS 2022, use one global scale per iteration — enough
+    because GBDT gradients are bounded by the loss curvature, not
+    heavy-tailed per-feature like DNN activations)."""
+    sg = jnp.maximum(jnp.max(jnp.abs(grad)), eps) / QUANT_MAX
+    sh = jnp.maximum(jnp.max(jnp.abs(hess)), eps) / QUANT_MAX
+    return sg, sh
+
+
+def stochastic_round_int8(x, scale, key):
+    """Unbiased stochastic rounding of ``x / scale`` to int8:
+    ``floor(v + u)`` with u ~ U[0, 1) has expectation exactly v, so the
+    quantization error is zero-mean noise the histogram bin sums average
+    out (variance ~ rows_in_bin) instead of a systematic bias."""
+    u = jax.random.uniform(key, x.shape)
+    q = jnp.floor(x / scale + u)
+    return jnp.clip(q, -QUANT_MAX, QUANT_MAX).astype(jnp.int8)
+
+
+def quantize_gh(grad, hess, key):
+    """(scale_g, scale_h, g_int8, h_int8) for one tree's gradients.
+    ``key`` must derive from the global tree index (fold_in) so the
+    fused scan and the per-iteration path draw bit-identical rounding
+    noise for the same tree — the quantized fused-parity contract."""
+    kg, kh = jax.random.split(key)
+    sg, sh = quant_scales(grad, hess)
+    return sg, sh, stochastic_round_int8(grad, sg, kg), \
+        stochastic_round_int8(hess, sh, kh)
+
 
 def num_chunks_for(m: int) -> int:
     """Scan chunk count for a window of static size m: chunked only when

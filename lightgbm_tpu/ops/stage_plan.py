@@ -1,0 +1,183 @@
+"""Wave-stage planning for the device grower.
+
+The grower splits a tree's growth into *stages*: each stage runs a
+``lax.while_loop`` of fixed-width waves, and the stage plan decides the
+wave width (histogram columns = width x stat columns) and the leaf-count
+cap at which the next, wider stage takes over.  The measured wave cost
+is ``fixed + col_ms * width * hist_cols``: the fixed part (the one-hot
+operand generation over all N rows) is width-independent, so at small
+frontiers it dominates and FEWER, WIDER stages win, while at large
+frontiers the column term dominates and width-matching the frontier
+wins.  ``ops/grow.py`` historically hardcoded a doubling plan from
+constants measured at 10.5M rows (scripts/ubench_hist.py); this module
+keeps that plan as the byte-stable default and adds
+
+* a cost model + simulator (``plan_cost``) over the leaf-growth
+  trajectory (a wave can split at most ``min(width, frontier, budget)``
+  leaves);
+* ``derive_stage_plan``: pick the cheapest plan from the doubling-ladder
+  family for MEASURED (fixed, col) costs;
+* a process-level plan cache keyed on the grower's (shape, config)
+  signature, filled by ``DeviceGrower.profile_stage_plan`` (which times
+  each candidate width with separately-jitted probes and records the
+  timings through the obs layer as ``grow.stage.w<W>``).
+
+The derived plan only replaces the default when profiling ran
+(``wave_plan=profiled``) or a cached profiled plan exists for the same
+signature (``wave_plan=auto``): wave batching order can move splits near
+the ``num_leaves`` budget boundary, so the unprofiled default must stay
+byte-identical across releases.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# constants measured on the chip at 10.5M rows (scripts/ubench_hist.py):
+# ~15.9 ms fixed one-hot operand generation + ~0.203 ms per stat column.
+# Both terms contract over all N rows, so ``fit_wave_costs`` scales them
+# linearly by rows/REF_ROWS when falling back for a different shape.
+DEFAULT_FIXED_MS = 15.9
+DEFAULT_COL_MS = 0.203
+REF_ROWS = 10_500_000
+
+Plan = List[Tuple[int, Optional[int]]]
+
+_PLAN_CACHE: Dict[tuple, Plan] = {}
+_PLAN_CACHE_LOCK = threading.Lock()
+
+
+def legacy_stage_plan(num_leaves: int, wave_width: int,
+                      hist_cols: int) -> Plan:
+    """The historical doubling plan (moved verbatim from ops/grow.py):
+    byte-stable — growth order near the leaf budget depends on it."""
+    scale = 3.0 / hist_cols
+    return [
+        (ws, cap) for ws, cap in
+        ((4, 8), (16, 32), (max(int(32 * scale), 4), 64),
+         (max(int(64 * scale), 4), 128))
+        if ws < wave_width and cap < num_leaves
+    ] + [(wave_width, None)]
+
+
+def plan_digest(plan: Sequence) -> str:
+    """Short stable digest of a stage plan (bench JSON attribution)."""
+    canon = repr([(int(w), None if c is None else int(c))
+                  for w, c in plan])
+    return hashlib.sha1(canon.encode()).hexdigest()[:10]
+
+
+def plan_cost_fn(plan: Sequence, num_leaves: int,
+                 wave_ms) -> Tuple[float, int]:
+    """(modeled ms per tree, wave count) for a full growth to
+    ``num_leaves`` given a per-width wave cost function.  Per wave at
+    most ``min(width, frontier, budget)`` splits apply: only existing
+    leaves can split, so a wide early wave still pays its full cost
+    while splitting few leaves."""
+    nl, cost, waves = 1, 0.0, 0
+    L = num_leaves
+    for ws, cap in plan:
+        limit = L if cap is None else min(cap, L)
+        while nl < limit:
+            s = min(ws, nl, L - nl)
+            if s <= 0:
+                break
+            nl += s
+            cost += wave_ms(ws)
+            waves += 1
+    return cost, waves
+
+
+def plan_cost(plan: Sequence, num_leaves: int, hist_cols: int,
+              fixed_ms: float, col_ms: float) -> Tuple[float, int]:
+    """plan_cost_fn under the linear fixed + col * width * k model."""
+    return plan_cost_fn(plan, num_leaves,
+                        lambda w: fixed_ms + col_ms * w * hist_cols)
+
+
+def _ladder(wave_width: int) -> List[int]:
+    out, w = [], 4
+    while w < wave_width:
+        out.append(w)
+        w *= 2
+    return out
+
+
+# a candidate plan must beat the incumbent by this margin to justify
+# its extra lax.while_loop stages: below it, the modeled saving is
+# measurement noise and fewer stages (smaller program, fewer compiled
+# loop bodies) win.  This is what turns a flat measured cost curve
+# ("per-wave fixed cost dominates at small frontiers") into FEWER,
+# WIDER stages instead of the full ladder.
+MIN_IMPROVEMENT = 0.02
+
+
+def derive_stage_plan(num_leaves: int, wave_width: int, hist_cols: int,
+                      fixed_ms: float, col_ms: float,
+                      measured_ms: Optional[Dict[int, float]] = None
+                      ) -> Plan:
+    """Cheapest plan from the doubling-ladder family: every subset of
+    intermediate widths {4, 8, 16, ...} (stage (w, 2w) runs width w
+    until the leaf count outgrows it) closed by the full-width stage.
+    The ladder has <= 6 rungs, so exhaustive search is trivial.
+
+    ``measured_ms`` (width -> per-wave ms, from the profile probes) is
+    used directly when present — the measured curve is typically NOT
+    linear at small widths (a minimum MXU tile / dispatch floor), which
+    is exactly what makes narrow early stages worthless on some shapes;
+    the linear (fixed, col) model only fills unprobed widths.  Candidates
+    are scanned fewest-stages-first and a longer plan must be at least
+    ``MIN_IMPROVEMENT`` cheaper to displace the incumbent."""
+    def wave_ms(w):
+        if measured_ms and w in measured_ms:
+            return float(measured_ms[w])
+        return fixed_ms + col_ms * w * hist_cols
+
+    rungs = _ladder(wave_width)
+    candidates: List[Plan] = [[(wave_width, None)]]
+    for mask in range(1, 1 << len(rungs)):
+        subset = [rungs[i] for i in range(len(rungs)) if mask >> i & 1]
+        candidates.append([(w, 2 * w) for w in subset
+                           if 2 * w < num_leaves] + [(wave_width, None)])
+    candidates.sort(key=len)
+    best_plan = candidates[0]
+    best_cost, _ = plan_cost_fn(best_plan, num_leaves, wave_ms)
+    for plan in candidates[1:]:
+        cost, _ = plan_cost_fn(plan, num_leaves, wave_ms)
+        if cost < best_cost * (1.0 - MIN_IMPROVEMENT):
+            best_cost, best_plan = cost, plan
+    return best_plan
+
+
+def fit_wave_costs(widths: Sequence[int], ms: Sequence[float],
+                   hist_cols: int,
+                   num_data: Optional[int] = None) -> Tuple[float, float]:
+    """Least-squares (fixed_ms, col_ms) from per-width probe timings.
+    Degenerate fits (negative slope/intercept from noisy small-scale
+    probes) fall back to the measured chip constants, scaled to
+    ``num_data`` rows when given (both cost terms are linear in N)."""
+    import numpy as np
+    x = np.asarray([w * hist_cols for w in widths], np.float64)
+    y = np.asarray(ms, np.float64)
+    if len(x) >= 2 and float(x.max() - x.min()) > 0:
+        col, fixed = np.polyfit(x, y, 1)
+    else:
+        col, fixed = -1.0, -1.0
+    if col <= 0 or fixed < 0:
+        scale = num_data / REF_ROWS if num_data else 1.0
+        return DEFAULT_FIXED_MS * scale, DEFAULT_COL_MS * scale
+    return float(fixed), float(col)
+
+
+def cached_plan(signature: tuple) -> Optional[Plan]:
+    with _PLAN_CACHE_LOCK:
+        plan = _PLAN_CACHE.get(signature)
+        return list(plan) if plan is not None else None
+
+
+def cache_plan(signature: tuple, plan: Sequence) -> None:
+    with _PLAN_CACHE_LOCK:
+        _PLAN_CACHE[signature] = [(int(w), None if c is None else int(c))
+                                  for w, c in plan]

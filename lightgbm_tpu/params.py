@@ -392,6 +392,34 @@ PARAM_SCHEMA: Sequence[Param] = (
             "experimental: currently slower than the einsum), interpret = "
             "Pallas interpreter mode (CPU testing), auto = einsum",
        section="device"),
+    _p("grad_quant_bits", int, 0, ("gradient_quant_bits", "quant_bits"),
+       check=">= 0",
+       desc="int8-quantized gradient histograms for the device grower: "
+            "0 (default) = full-precision bf16 hi/lo wave histograms; 8 = "
+            "stochastically round grad/hess to int8 against a per-tree "
+            "global scale so the wave contraction runs on the MXU's native "
+            "int8->int32 path. Histograms are dequantized once in f32 "
+            "before split-gain evaluation, counts stay integer-exact, and "
+            "leaf values are refit from full-precision gradients after "
+            "growth (Shi et al., Quantized Training of GBDT, NeurIPS "
+            "2022). Ignored with gpu_use_dp. Only 0 and 8 are accepted",
+       section="device"),
+    _p("wave_plan", str, "auto", (),
+       check="auto/fixed/profiled",
+       desc="wave-stage plan for the device grower (ops/stage_plan.py): "
+            "fixed = the byte-stable doubling plan; profiled = time every "
+            "candidate stage width on the real binned matrix at init, fit "
+            "the fixed-vs-per-column wave cost model and install the "
+            "cheapest plan (cached per (shape, config) signature, so "
+            "retrain windows measure once); auto = the fixed plan unless "
+            "a profiled plan is already cached for this signature",
+       section="device"),
+    _p("grower_cache", bool, True, (),
+       desc="share the device grower's jitted programs process-wide, "
+            "keyed on (shape signature, config hash): a warm retrain "
+            "window re-dispatches into already-traced programs (zero new "
+            "traces; obs counters grow.cache_hits/grow.cache_misses). "
+            "Disable only to debug trace-level issues", section="device"),
     _p("device_growth", str, "auto", ("tpu_device_growth",),
        check="auto/on/off",
        desc="fully on-device wave-synchronized tree growth (one dispatch "

@@ -136,6 +136,15 @@ class SerialTreeLearner:
         self.forced_splits = None   # parsed forced-split JSON (dict) or None
         # reference gpu_use_dp: double-precision-equivalent accumulation
         self._dp = bool(getattr(config, "gpu_use_dp", False))
+        # int8 histogram quantization is a device-grower representation
+        # (ops/grow.py); this host path is the full-precision reference
+        # the quantized-parity tests compare against, so it NEVER
+        # quantizes.  Surface that on the first host-grown tree when the
+        # config asked for it (device_growth off/ineligible fallback) —
+        # warned lazily because every booster constructs this learner
+        # even when the device grower ends up doing all the growing.
+        self._warn_quant = int(getattr(config, "grad_quant_bits", 0)
+                               or 0) > 0
 
     @property
     def traverse_binned(self):
@@ -253,6 +262,11 @@ class SerialTreeLearner:
         whose first ``data_count`` entries are the usable rows); defaults to
         all rows."""
         cfg = self.config
+        if self._warn_quant:
+            self._warn_quant = False
+            log_warning("grad_quant_bits is only applied by the "
+                        "on-device grower; the host tree learner keeps "
+                        "full-precision f32 histograms")
         grad, hess = self._init_state(indices_buffer, data_count, grad, hess)
         if feature_mask is None:
             feature_mask = self._feature_mask()

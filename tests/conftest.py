@@ -18,6 +18,13 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# device-grower histogram chunk: the wave einsum runs over n_pad =
+# ceil(rows, CHUNK) rows, so the production default of 32768 makes every
+# small-dataset CPU test pay 32768-row matmuls regardless of its actual
+# size — 8192 cuts that ~4x.  Trees are padding-invariant (padded rows
+# carry zero weight); only float reduction order shifts, which the
+# tolerance-based tests already absorb.
+os.environ.setdefault("LGBM_TPU_CHUNK", "8192")
 
 import jax  # noqa: E402
 
@@ -80,6 +87,49 @@ def _timeout_guard(request):
     finally:
         signal.alarm(0)
         signal.signal(signal.SIGALRM, old)
+
+
+def train_device_booster(params, x, y, n_iters, chunk=0, query=None):
+    """Construct + train a device-growth booster (shared by the fused
+    and quantized parity suites; base params come from the caller)."""
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data.dataset import BinnedDataset
+
+    cfg = Config(dict(params))
+    ds = BinnedDataset.construct_from_matrix(x, cfg)
+    ds.metadata.set_label(y)
+    if query is not None:
+        ds.metadata.set_query(query)
+    bst = create_boosting(cfg)
+    bst.init_train(ds)
+    if chunk:
+        bst.train_chunked(n_iters, chunk=chunk)
+    else:
+        for _ in range(n_iters):
+            if bst.train_one_iter():
+                break
+    bst._flush_pending()
+    return bst
+
+
+def assert_models_bit_identical(a, b):
+    """Trees, thresholds, leaf values AND final training scores must be
+    byte-equal: the fused scan re-draws bagging/feature_fraction masks
+    (and int8 quantization noise) on device with the per-iteration
+    path's exact seeding, so there is no tolerance to hide behind."""
+    assert len(a.models) == len(b.models)
+    for i, (ta, tb) in enumerate(zip(a.models, b.models)):
+        assert ta.num_leaves == tb.num_leaves, f"tree {i}"
+        nl = ta.num_leaves
+        np.testing.assert_array_equal(ta.split_feature[:nl - 1],
+                                      tb.split_feature[:nl - 1])
+        np.testing.assert_array_equal(ta.threshold[:nl - 1],
+                                      tb.threshold[:nl - 1])
+        np.testing.assert_array_equal(ta.leaf_value[:nl],
+                                      tb.leaf_value[:nl])
+    np.testing.assert_array_equal(np.asarray(a.train_score),
+                                  np.asarray(b.train_score))
 
 
 def load_svmlight(path, n_features=None):

@@ -93,6 +93,67 @@ def test_windowed_retrain_harness():
     assert max(times) < 2.5 * min(times) + 1.0, times
 
 
+def test_grower_cache_warm_window_zero_new_traces():
+    """The retrain-every-window pattern builds a fresh DeviceGrower per
+    window; the process-level program cache (ops/grow.py) must make the
+    SECOND same-shaped window reuse the first window's jitted programs —
+    zero new traces/compiles, counted through the obs jit tracker."""
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.config import Config
+
+    params = {"objective": "binary", "device_growth": "on",
+              "num_leaves": 15, "max_bin": 63, "min_data_in_leaf": 5,
+              "verbosity": -1}
+
+    def window(seed):
+        wrng = np.random.default_rng(seed)
+        x = wrng.standard_normal((2000, 8)).astype(np.float32)
+        y = (x[:, 0] + np.abs(x[:, 1]) > 0.5).astype(np.float32)
+        cfg = Config(params)
+        ds = BinnedDataset.construct_from_matrix(x, cfg)
+        ds.metadata.set_label(y)
+        bst = create_boosting(cfg)
+        bst.init_train(ds)
+        assert bst._grower is not None
+        bst.train_chunked(4, chunk=2)
+        bst._flush_pending()
+        return bst
+
+    was_enabled = obs.enabled()
+    obs.configure(enabled=True)
+    try:
+        reg = obs.registry()
+        hits0 = reg.counter("grow.cache_hits")
+        b1 = window(1)
+        progs1 = b1._grower.programs
+        compiles_after_w1 = sum(
+            v["compiles"] for v in reg.snapshot()["jit"].values())
+        b2 = window(2)
+        compiles_after_w2 = sum(
+            v["compiles"] for v in reg.snapshot()["jit"].values())
+        # same programs object adopted (cache hit), zero new compiles
+        assert b2._grower.programs is progs1
+        assert reg.counter("grow.cache_hits") >= hits0 + 1
+        assert compiles_after_w2 == compiles_after_w1, \
+            reg.snapshot()["jit"]
+        # the obs tracker can only see compiles it can attribute; the
+        # underlying jax.jit caches are the ground truth.  The fused
+        # program is the hazard: grad_fn is a STATIC argument, so a
+        # fresh per-window closure would silently re-trace the whole
+        # scan (DeviceGradFn's stable eq/hash is what prevents it)
+        fused_sizes = {ln: tj._cache_size()
+                       for ln, tj in progs1._fused.items()}
+        assert fused_sizes and all(v == 1 for v in fused_sizes.values()), \
+            fused_sizes
+        # both windows actually trained (the cached programs served
+        # window 2's different data through the argument-passed arrays)
+        assert len(b1.models) == len(b2.models) == 4
+    finally:
+        if not was_enabled:
+            obs.configure(enabled=False)
+
+
 def test_sparse_dataset_never_densifies(monkeypatch):
     """The Dataset construction path must not call toarray() on sparse
     input (memory ~ nnz is the CSR-ingestion contract)."""

@@ -7,8 +7,9 @@ path, falls back when ineligible, and stops on stump stalls.
 """
 
 import numpy as np
+import pytest
+from conftest import assert_models_bit_identical, train_device_booster
 
-from lightgbm_tpu.boosting import create_boosting
 from lightgbm_tpu.config import Config
 from lightgbm_tpu.data.dataset import BinnedDataset
 
@@ -36,22 +37,10 @@ def _rank_data(rows=1200, cols=8, seed=5):
 
 
 def _train(params, x, y, n_iters, chunk=0, query=None):
-    cfg = Config({"verbosity": -1, "device_growth": "on",
-                  "num_leaves": 15, "min_data_in_leaf": 5, **params})
-    ds = BinnedDataset.construct_from_matrix(x, cfg)
-    ds.metadata.set_label(y)
-    if query is not None:
-        ds.metadata.set_query(query)
-    bst = create_boosting(cfg)
-    bst.init_train(ds)
-    if chunk:
-        bst.train_chunked(n_iters, chunk=chunk)
-    else:
-        for _ in range(n_iters):
-            if bst.train_one_iter():
-                break
-    bst._flush_pending()
-    return bst
+    return train_device_booster(
+        {"verbosity": -1, "device_growth": "on", "num_leaves": 15,
+         "min_data_in_leaf": 5, **params},
+        x, y, n_iters, chunk=chunk, query=query)
 
 
 def _assert_same_models(a, b):
@@ -66,23 +55,7 @@ def _assert_same_models(a, b):
             tb.leaf_value[:tb.num_leaves], rtol=2e-4, atol=1e-6)
 
 
-def _assert_bit_identical(a, b):
-    """Trees, thresholds, leaf values AND final training scores must be
-    byte-equal: the fused scan re-draws bagging/feature_fraction masks
-    on device with the per-iteration path's exact seeding, so there is
-    no tolerance to hide behind."""
-    assert len(a.models) == len(b.models)
-    for i, (ta, tb) in enumerate(zip(a.models, b.models)):
-        assert ta.num_leaves == tb.num_leaves, f"tree {i}"
-        nl = ta.num_leaves
-        np.testing.assert_array_equal(ta.split_feature[:nl - 1],
-                                      tb.split_feature[:nl - 1])
-        np.testing.assert_array_equal(ta.threshold[:nl - 1],
-                                      tb.threshold[:nl - 1])
-        np.testing.assert_array_equal(ta.leaf_value[:nl],
-                                      tb.leaf_value[:nl])
-    np.testing.assert_array_equal(np.asarray(a.train_score),
-                                  np.asarray(b.train_score))
+_assert_bit_identical = assert_models_bit_identical
 
 
 def test_binary_chunked_matches_per_iter():
@@ -95,6 +68,9 @@ def test_binary_chunked_matches_per_iter():
                                rtol=2e-4, atol=1e-5)
 
 
+# slow: trains the same model three ways (chunked + remainder +
+# reference) => an extra fused-scan compile tier-1 can't spare
+@pytest.mark.slow
 def test_binary_chunk_remainder_uses_per_iter_path():
     # 10 = 2 chunks of 4 + remainder 2 via train_one_iter
     x, y = _binary_data(rows=1500)
@@ -113,6 +89,9 @@ def test_regression_chunked_matches_per_iter():
     _assert_same_models(a, b)
 
 
+# slow: the lambdarank device gradient compiles a large sorted-pair
+# program inside the fused scan
+@pytest.mark.slow
 def test_lambdarank_chunked_matches_per_iter():
     x, y, q = _rank_data()
     a = _train({"objective": "lambdarank"}, x, y, 8, query=q)
@@ -153,6 +132,9 @@ def test_feature_fraction_chunked_bit_identical():
     _assert_bit_identical(a, b)
 
 
+# slow: the heaviest parity case (bagging + feature_fraction, 14
+# iterations, chunk remainder) — scripts/check.sh full mode runs it
+@pytest.mark.slow
 def test_fork_harness_config_chunked_bit_identical():
     # bagging + feature_fraction together, chunk boundaries landing both
     # on and off the bagging_freq=5 redraw cadence, plus a per-iteration

@@ -269,11 +269,15 @@ def test_pallas_hist_matches_einsum(reg_data):
     agree with the XLA einsum formulation bin-for-bin."""
     import jax.numpy as jnp
     x, y = reg_data
+    # grower_cache off: the flags tweaked below live on the (otherwise
+    # process-shared) GrowerPrograms object, so this test needs a
+    # private instance
     params = {"objective": "regression", "num_leaves": 64,
-              "min_data_in_leaf": 50}
+              "min_data_in_leaf": 50, "grower_cache": False}
     bd = _make(params, x, y, True)
-    grower = bd._grower
+    grower = bd._grower.programs
     assert grower is not None
+    binned = bd._grower.binned
     n = grower.n_pad
     rng = np.random.default_rng(0)
     leaf = jnp.asarray(rng.integers(0, 8, n).astype(np.int32))
@@ -289,10 +293,10 @@ def test_pallas_hist_matches_einsum(reg_data):
         np.concatenate([np.arange(6), [-1] * (grower.wave_width - 6)])
         .astype(np.int32))
     grower.use_pallas = False
-    ref = np.asarray(grower._wave_hist(grower.binned, leaf, ghk, pending))
+    ref = np.asarray(grower._wave_hist(binned, leaf, ghk, pending))
     grower.use_pallas = True
     grower.pallas_interpret = True
-    got = np.asarray(grower._wave_hist(grower.binned, leaf, ghk, pending))
+    got = np.asarray(grower._wave_hist(binned, leaf, ghk, pending))
     np.testing.assert_allclose(got, ref, atol=1e-3, rtol=1e-4)
 
 

@@ -35,7 +35,11 @@ def _free_port():
 
 
 # enforced by pytest-timeout when installed, else by the SIGALRM
-# fallback fixture in conftest.py — either way the 420 s cap is real
+# fallback fixture in conftest.py — either way the 420 s cap is real.
+# slow: two fresh interpreters each pay full jax + XLA compile startup —
+# minutes of wall-clock tier-1 can't spare (scripts/check.sh full mode
+# runs the slow set in its own step)
+@pytest.mark.slow
 @pytest.mark.timeout(420)
 def test_two_process_distributed(tmp_path):
     nproc = 2

@@ -1,0 +1,130 @@
+"""Wave-stage planner (ops/stage_plan.py): cost model, plan derivation,
+byte-stable default, and the profile-guided install path."""
+
+import numpy as np
+
+from lightgbm_tpu.ops import stage_plan as sp
+
+
+def test_legacy_plan_matches_historical_doubling():
+    # the exact plan ops/grow.py hardcoded pre-refactor for L=255, k=3
+    plan = sp.legacy_stage_plan(255, 128, 3)
+    assert plan == [(4, 8), (16, 32), (32, 64), (64, 128), (128, None)]
+    # dp (k=5) scales widths by 3/5, cap list unchanged
+    plan5 = sp.legacy_stage_plan(255, 76, 5)
+    assert plan5 == [(4, 8), (16, 32), (19, 64), (38, 128), (76, None)]
+    # small trees collapse to the single full-width stage
+    assert sp.legacy_stage_plan(15, 14, 3) == [(4, 8), (14, None)]
+
+
+def test_plan_cost_counts_frontier_limited_waves():
+    # frontier-limited growth: 1->2->4->...->128->255 is 8 waves no
+    # matter how wide the stage is (only existing leaves can split)
+    cost, waves = sp.plan_cost([(128, None)], 255, 3, 10.0, 0.1)
+    assert waves == 8
+    # the doubling ladder runs the SAME wave count but each early wave
+    # carries fewer columns, so it is never more expensive
+    legacy = sp.legacy_stage_plan(255, 128, 3)
+    cost_l, waves_l = sp.plan_cost(legacy, 255, 3, 10.0, 0.1)
+    assert waves_l == 8
+    assert cost_l < cost
+    # a too-narrow stage defers frontier splits => more waves
+    _, waves_n = sp.plan_cost([(4, 128), (128, None)], 255, 3, 10.0, 0.1)
+    assert waves_n > 8
+
+
+def test_derive_prefers_wide_when_fixed_dominates():
+    # flat measured cost curve (per-wave fixed cost dominates at small
+    # frontiers): staging saves nothing, so fewer, wider stages win
+    flat = {w: 100.0 for w in (4, 8, 16, 32, 64, 128)}
+    plan = sp.derive_stage_plan(255, 128, 3, 100.0, 1e-4,
+                                measured_ms=flat)
+    assert plan == [(128, None)]
+    # column cost dominates: staging pays for itself
+    plan2 = sp.derive_stage_plan(255, 128, 3, fixed_ms=1e-3, col_ms=1.0)
+    assert len(plan2) > 1
+    c1, _ = sp.plan_cost(plan2, 255, 3, 1e-3, 1.0)
+    c2, _ = sp.plan_cost([(128, None)], 255, 3, 1e-3, 1.0)
+    assert c1 < c2
+
+
+def test_fit_wave_costs_recovers_linear_model():
+    widths = [4, 8, 16, 32, 64, 128]
+    fixed, col = 12.0, 0.25
+    ms = [fixed + col * w * 3 for w in widths]
+    f, c = sp.fit_wave_costs(widths, ms, 3)
+    np.testing.assert_allclose([f, c], [fixed, col], rtol=1e-6)
+    # degenerate probes fall back to the chip constants
+    f2, c2 = sp.fit_wave_costs([4], [1.0], 3)
+    assert (f2, c2) == (sp.DEFAULT_FIXED_MS, sp.DEFAULT_COL_MS)
+    # ... row-scaled when the caller's shape is known
+    f3, c3 = sp.fit_wave_costs([4], [1.0], 3, num_data=sp.REF_ROWS // 2)
+    np.testing.assert_allclose(
+        [f3, c3], [sp.DEFAULT_FIXED_MS / 2, sp.DEFAULT_COL_MS / 2])
+
+
+def test_plan_digest_stable_and_cache_roundtrip():
+    plan = [(4, 8), (128, None)]
+    d1 = sp.plan_digest(plan)
+    assert d1 == sp.plan_digest([[4, 8], [128, None]])
+    assert d1 != sp.plan_digest([(8, 16), (128, None)])
+    sig = ("test-sig", 1, 2)
+    assert sp.cached_plan(sig) is None
+    sp.cache_plan(sig, plan)
+    assert sp.cached_plan(sig) == [(4, 8), (128, None)]
+
+
+def test_profile_stage_plan_records_and_installs():
+    """End-to-end: probe timings land in obs, the derived plan installs
+    on the grower, and a second same-signature grower picks it up from
+    the plan cache (wave_plan=auto)."""
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data.dataset import BinnedDataset
+
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((1500, 6)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    params = {"objective": "binary", "device_growth": "on",
+              "num_leaves": 31, "max_bin": 63, "verbosity": -1,
+              "seed": 1234567}   # unique seed => private cache signature
+
+    def build():
+        cfg = Config(params)
+        ds = BinnedDataset.construct_from_matrix(x, cfg)
+        ds.metadata.set_label(y)
+        bst = create_boosting(cfg)
+        bst.init_train(ds)
+        return bst
+
+    was_enabled = obs.enabled()
+    obs.configure(enabled=True)
+    try:
+        b1 = build()
+        out = b1._grower.profile_stage_plan(reps=1)
+        assert out["stage_ms"], out
+        assert out["plan"][-1][1] is None
+        assert b1._grower.stage_plan == out["plan"]
+        gauges = obs.registry().snapshot()["gauges"]
+        assert any(k.startswith("grow.stage.w") for k in gauges), gauges
+        # second grower with the same signature adopts the cached plan
+        b2 = build()
+        assert b2._grower.stage_plan == out["plan"]
+        assert b2._grower.plan_source == "profiled"
+        # the plan-cache signature must ignore wave_plan itself: a
+        # profiled-config run of the same workload adopts the cached
+        # plan instead of digesting differently and re-measuring
+        params["wave_plan"] = "profiled"
+        b3 = build()
+        assert b3._grower.stage_plan == out["plan"]
+        assert b3._grower.plan_source == "profiled"
+        params["wave_plan"] = "auto"
+        # the re-planned grower still trains
+        for _ in range(2):
+            b2.train_one_iter()
+        b2._flush_pending()
+        assert len(b2.models) == 2
+    finally:
+        if not was_enabled:
+            obs.configure(enabled=False)
