@@ -459,6 +459,91 @@ def run_mslr(args) -> dict:
     }
 
 
+def run_serve(args) -> dict:
+    """Packed-ensemble serving benchmark (lightgbm_tpu.serve): train a
+    HIGGS-shaped model once, then measure PredictionServer throughput
+    (rows/s) and per-call latency p50/p95 across a spread of batch
+    sizes, plus the hot-swap retrace check the window loop relies on."""
+    import jax
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data.dataset import BinnedDataset
+    from lightgbm_tpu.serve import PredictionServer
+
+    rows = min(args.rows, 1_000_000 if not args.quick else 200_000)
+    iters = min(args.iters, 50)
+    x, y = synth_higgs(rows)
+    cfg = Config({"objective": "binary", "num_leaves": 31,
+                  "max_bin": args.max_bin, "learning_rate": 0.1,
+                  "verbosity": -1, "device_growth": "auto"})
+
+    def train(seed_rows):
+        ds = BinnedDataset.construct_from_matrix(seed_rows, cfg)
+        ds.metadata.set_label(y[:seed_rows.shape[0]])
+        bst = create_boosting(cfg)
+        bst.init_train(ds)
+        bst.train_chunked(iters, chunk=min(args.chunk or 10, iters))
+        bst._flush_pending()
+        return bst
+
+    bst = train(x)
+    server = PredictionServer(bst)
+
+    batch = 65536 if not args.quick else 8192
+    t0 = time.perf_counter()
+    server.warmup((512, batch))
+    warmup_s = time.perf_counter() - t0
+
+    rng = np.random.default_rng(11)
+    xq = rng.standard_normal((batch, x.shape[1]))
+    reps = 8 if not args.quick else 4
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = server.predict(xq)
+    timed_s = time.perf_counter() - t0
+    assert np.isfinite(out).all()
+
+    # small-batch latency distribution, sampled explicitly so the big-
+    # batch throughput reps above don't pollute the percentiles
+    lat_samples = []
+    for _ in range(32):
+        t1 = time.perf_counter()
+        server.predict(xq[:512])
+        lat_samples.append(time.perf_counter() - t1)
+
+    # hot-swap: a same-shaped retrain window must not retrace
+    snap = obs.registry().snapshot()["jit"] if obs.enabled() else {}
+    compiles_before = sum(v["compiles"] for v in snap.values())
+    same_shape = server.swap(train(x))
+    server.predict(xq[:512])
+    snap = obs.registry().snapshot()["jit"] if obs.enabled() else {}
+    compiles_after = sum(v["compiles"] for v in snap.values())
+
+    lat = {"latency_rows": 512,
+           "latency_p50_ms": round(
+               float(np.percentile(lat_samples, 50)) * 1e3, 3),
+           "latency_p95_ms": round(
+               float(np.percentile(lat_samples, 95)) * 1e3, 3)}
+    pe = server.packed
+    return {
+        "metric": f"serve_packed_{batch}row_batch_rows_per_sec",
+        "value": round(batch * reps / timed_s, 0),
+        "unit": "rows/s",
+        "batch_rows": batch,
+        "reps": reps,
+        "timed_s": round(timed_s, 3),
+        "warmup_s": round(warmup_s, 2),
+        "trees": pe.num_trees,
+        "tree_pad": int(pe.split_feature.shape[0]),
+        "depth_pad": pe.max_depth,
+        "swap_same_shape": bool(same_shape),
+        "swap_retrace_zero": compiles_after == compiles_before,
+        "backend": jax.default_backend(),
+        **lat,
+    }
+
+
 def run_cache_admission(args) -> dict:
     """The fork's windowed cache-admission harness
     (examples/cache_admission.py) through the C API's chunked update —
@@ -533,12 +618,15 @@ def main() -> int:
                     help="device = on-device wave grower (one dispatch per "
                          "iteration); host = host-driven learner; auto = "
                          "device on TPU")
-    ap.add_argument("--suite", choices=["all", "higgs", "mslr", "cache"],
+    ap.add_argument("--suite",
+                    choices=["all", "higgs", "mslr", "cache", "serve"],
                     default=os.environ.get("BENCH_SUITE", "all"),
                     help="all = HIGGS headline + MSLR lambdarank "
                          "(both north stars, BASELINE.md); cache = the "
                          "fork's windowed cache-admission harness vs its "
-                         "125.4 s/20M-window reference")
+                         "125.4 s/20M-window reference; serve = packed-"
+                         "ensemble PredictionServer throughput + latency "
+                         "p50/p95 + hot-swap retrace check")
     ap.add_argument("--cache-admission", action="store_true",
                     help="alias for --suite cache")
     ap.add_argument("--metrics", default=os.environ.get("BENCH_METRICS",
@@ -582,6 +670,8 @@ def main() -> int:
         args.suite = "cache"
     if args.suite == "cache":
         result = run_cache_admission(args)
+    elif args.suite == "serve":
+        result = run_serve(args)
     elif args.suite == "mslr":
         result = run_mslr(args)
     else:

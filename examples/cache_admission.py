@@ -187,12 +187,18 @@ def derive_features(ids, sizes, costs, to_cache, cache_size,
 class CApiTrainer:
     """trainModel/evaluateModel (test.cpp:211-298) over lightgbm_tpu's
     C-API compatibility layer — fresh booster per window, like the
-    fork's 'train a new booster' branch."""
+    fork's 'train a new booster' branch.  The READ side goes through
+    the hot-swap prediction server (LGBM_Serve*): window 0 creates it,
+    every later window atomically ``swap``s in the freshly trained
+    model, and evaluation predicts against the server's packed
+    ensemble — at steady state the swap re-dispatches into already-
+    compiled device programs (zero retraces, docs/Serving.md)."""
 
     def __init__(self):
         from lightgbm_tpu import c_api as C
         self.C = C
         self.booster = None
+        self.server = None
 
     def _check(self, rc):
         if rc != 0:
@@ -215,6 +221,14 @@ class CApiTrainer:
         fin = C.Ref()
         self._check(C.LGBM_BoosterUpdateChunked(
             bst.value, NUM_ITERATIONS, TRAIN_CHUNK, fin))
+        # hand the new model to the serving side (the server keeps its
+        # own packed copy, so the old booster frees safely)
+        if self.server is None:
+            srv = C.Ref()
+            self._check(C.LGBM_ServeCreate(bst.value, TRAIN_PARAMS, srv))
+            self.server = srv.value
+        else:
+            self._check(C.LGBM_ServeSwap(self.server, bst.value))
         if self.booster is not None:
             self._check(C.LGBM_BoosterFree(self.booster))
         self.booster = bst.value
@@ -225,11 +239,10 @@ class CApiTrainer:
         nrow = len(indptr) - 1
         out_len = C.Ref()
         result = np.zeros(nrow, np.float64)
-        self._check(C.LGBM_BoosterPredictForCSR(
-            self.booster, indptr, C.C_API_DTYPE_INT32, indices, data,
+        self._check(C.LGBM_ServePredictForCSR(
+            self.server, indptr, C.C_API_DTYPE_INT32, indices, data,
             C.C_API_DTYPE_FLOAT64, len(indptr), len(data),
-            HISTFEATURES + 3, C.C_API_PREDICT_NORMAL, 0, TRAIN_PARAMS,
-            out_len, result))
+            HISTFEATURES + 3, C.C_API_PREDICT_NORMAL, out_len, result))
         fp = float(((labels < cutoff) & (result >= cutoff)).sum())
         fn = float(((labels >= cutoff) & (result < cutoff)).sum())
         return fp / len(labels), fn / len(labels)

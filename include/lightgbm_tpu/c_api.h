@@ -97,4 +97,38 @@ LIGHTGBM_C_EXPORT int LGBM_BoosterSaveModel(BoosterHandle handle,
                                             int num_iteration,
                                             const char* filename);
 
+/* ---------------------------------------------------------------------
+ * Prediction server (lightgbm_tpu extension, not in the fork's ABI):
+ * a hot-swap packed-ensemble predictor.  The windowed harness creates
+ * ONE server, scores every request window against it, and swaps in
+ * each freshly retrained booster — a swap whose padded model shape
+ * matches the previous window re-dispatches into already-compiled
+ * device programs (zero recompiles at steady state).  The server keeps
+ * its own copy of the model, so the booster may be freed after a swap.
+ * ------------------------------------------------------------------ */
+typedef void* ServeHandle;
+
+/* Recognized parameters: num_iteration_predict (served tree slice),
+ * serve_max_batch / serve_max_wait_ms (micro-batch queue). */
+LIGHTGBM_CPP_EXPORT int LGBM_ServeCreate(
+    const BoosterHandle booster,
+    std::unordered_map<std::string, std::string> parameters,
+    ServeHandle* out);
+
+LIGHTGBM_C_EXPORT int LGBM_ServeSwap(ServeHandle handle,
+                                     const BoosterHandle booster);
+
+LIGHTGBM_C_EXPORT int LGBM_ServeCalcNumPredict(ServeHandle handle,
+                                               int num_row,
+                                               int64_t* out_len);
+
+/* predict_type: C_API_PREDICT_NORMAL or C_API_PREDICT_RAW_SCORE. */
+LIGHTGBM_C_EXPORT int LGBM_ServePredictForCSR(
+    ServeHandle handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t num_col, int predict_type,
+    int64_t* out_len, double* out_result);
+
+LIGHTGBM_C_EXPORT int LGBM_ServeFree(ServeHandle handle);
+
 #endif  /* LIGHTGBM_TPU_C_API_H_ */

@@ -19,6 +19,7 @@ __all__ = [
     "Config", "LightGBMError", "register_log_callback", "set_verbosity",
     "Dataset", "Booster", "train", "cv",
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
+    "PredictionServer",
 ]
 
 
@@ -26,6 +27,9 @@ def __getattr__(name):
     if name in ("Dataset", "Booster"):
         from . import basic
         return getattr(basic, name)
+    if name == "PredictionServer":
+        from .serve import PredictionServer
+        return PredictionServer
     if name in ("train", "cv"):
         from . import engine
         return getattr(engine, name)

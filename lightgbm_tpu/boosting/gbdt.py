@@ -897,41 +897,49 @@ class GBDT:
                     "multiclass objectives; ignoring")
         return None
 
-    # rows above which batch prediction routes through the on-device
-    # traversal (requires a live train_set for the bin mappers); below
-    # it the host trees win on latency
-    DEVICE_PREDICT_ROWS = 65536
+    def _predict_raw_packed(self, data, end_iter, start_iteration):
+        """Batch prediction through the packed-forest kernel
+        (``serve/packed.py``): the whole tree slice flattens into one
+        set of padded device arrays keyed on RAW feature values and the
+        batch routes through every tree in a SINGLE jitted dispatch —
+        no binning, no ``train_set``, so file-loaded models take this
+        path too.  Leaf ROUTING is bit-identical to the host walk
+        (hi/lo float32 threshold pairs reproduce the float64 compare);
+        ACCUMULATION is float32 on device vs the host path's float64,
+        so values differ ~1e-6 relative across the row threshold (see
+        docs/Serving.md).
 
-    def _predict_raw_device(self, data, end_iter, start_iteration):
-        """Batch prediction via binning + on-device tree traversal: at
-        harness scale (millions of rows x 50 trees) the host-side
-        ``Tree.predict`` loop measured ~1 s/tree; binning once and
-        traversing on device is ~4x faster end to end.  Leaf ROUTING is
-        exact (bin thresholds encode the same raw-value comparisons);
-        accumulation is float32 on device vs the host path's float64,
-        so values differ ~1e-6 relative across the row threshold."""
-        from ..ops.traverse import add_tree_score, device_tree
-        vds = BinnedDataset.construct_from_matrix(
-            data, self.config, reference=self.train_set)
-        binned_d = jnp.asarray(vds.binned)
-        n = data.shape[0]
-        out = np.zeros((self.num_model, n), np.float64)
-        score = [jnp.zeros(n, jnp.float32)
-                 for _ in range(self.num_model)]
-        bias = np.zeros(self.num_model)
-        for it in range(start_iteration, end_iter):
-            for k in range(self.num_model):
-                tree = self.models[it * self.num_model + k]
-                if tree.num_leaves > 1:
-                    score[k] = add_tree_score(
-                        score[k], binned_d,
-                        device_tree(tree, self.train_set,
-                                    self.config.num_leaves), 1.0)
-                else:
-                    bias[k] += tree.leaf_value[0]
-        for k in range(self.num_model):
-            out[k] = np.asarray(score[k], np.float64) + bias[k]
-        return out
+        The pack is cached per (slice, model count): repeated big-batch
+        predicts (per-window eval loops) skip the re-flatten + upload.
+        Training/rollback changes ``len(self.models)`` and invalidates
+        the key; in-place leaf edits on a Tree do NOT — use a fresh
+        Booster (like ``refit`` does) for that."""
+        from ..serve.packed import pack_ensemble, predict_scores
+        key = (start_iteration, end_iter, len(self.models),
+               self.num_model)
+        cached = getattr(self, "_packed_cache", None)
+        if cached is None or cached[0] != key:
+            pe = pack_ensemble(self.models, self.num_model,
+                               start_iteration=start_iteration,
+                               num_iteration=end_iter - start_iteration,
+                               num_features=self.max_feature_idx + 1)
+            self._packed_cache = cached = (key, pe)
+        return predict_scores(cached[1], data)
+
+    def _device_predict_wanted(self, n: int, early) -> bool:
+        """Routing for ``predict_raw``: ``device_predict`` force/off
+        override the ``device_predict_min_rows`` auto threshold;
+        row-wise prediction early stopping is host-only (the device
+        kernel runs all trees unconditionally)."""
+        if early is not None:
+            return False
+        mode = str(getattr(self.config, "device_predict", "auto")).lower()
+        if mode == "off":
+            return False
+        if mode == "force":
+            return True
+        return n >= int(getattr(self.config, "device_predict_min_rows",
+                                65536))
 
     def predict_raw(self, data: np.ndarray, num_iteration: int = -1,
                     start_iteration: int = 0) -> np.ndarray:
@@ -943,9 +951,9 @@ class GBDT:
         end_iter = total_iter if num_iteration <= 0 \
             else min(start_iteration + num_iteration, total_iter)
         early = self._early_stop_instance()
-        if (early is None and self.train_set is not None
-                and n >= self.DEVICE_PREDICT_ROWS):
-            out = self._predict_raw_device(data, end_iter,
+        if (n > 0 and end_iter > start_iteration
+                and self._device_predict_wanted(n, early)):
+            out = self._predict_raw_packed(data, end_iter,
                                            start_iteration)
             if self.average_output and end_iter > start_iteration:
                 out /= (end_iter - start_iteration)
@@ -975,12 +983,17 @@ class GBDT:
         if pred_leaf:
             data = np.ascontiguousarray(np.asarray(data, np.float64))
             total_iter = self.num_iterations()
+            # same slice semantics as predict_raw: [start_iteration,
+            # start_iteration + num_iteration) — pred_leaf used to
+            # ignore start_iteration and slice [0, num_iteration)
+            start_iteration = max(0, min(start_iteration, total_iter))
             end_iter = total_iter if num_iteration <= 0 \
-                else min(num_iteration, total_iter)
-            leaves = np.zeros((data.shape[0],
-                               end_iter * self.num_model), np.int32)
-            for i in range(end_iter * self.num_model):
-                leaves[:, i] = self.models[i].predict_leaf(data)
+                else min(start_iteration + num_iteration, total_iter)
+            base = start_iteration * self.num_model
+            n_trees = max(end_iter - start_iteration, 0) * self.num_model
+            leaves = np.zeros((data.shape[0], n_trees), np.int32)
+            for i in range(n_trees):
+                leaves[:, i] = self.models[base + i].predict_leaf(data)
             return leaves
         if pred_contrib:
             return self._predict_contrib(data, num_iteration)

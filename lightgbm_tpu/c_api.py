@@ -25,6 +25,7 @@ the ``parameters`` string argument, so a port is a transliteration.
 from __future__ import annotations
 
 import json
+import threading
 from typing import Dict, Optional
 
 import numpy as np
@@ -108,22 +109,45 @@ class _BoosterEntry:
         self.custom_objective = False
 
 
+class _ServeEntry:
+    """A hot-swap PredictionServer behind an opaque handle
+    (lightgbm_tpu extension — LGBM_Serve* functions)."""
+
+    __slots__ = ("server",)
+
+    def __init__(self, server):
+        self.server = server
+
+
 _handles: Dict[int, object] = {}
 _next_handle = 1
+# the serving setup is multi-threaded by design (PredictionServer micro-
+# batch worker + harness threads), so handle allocation/free must not race
+_HANDLES_LOCK = threading.Lock()
 
 
 def _register(obj) -> int:
     global _next_handle
-    h = _next_handle
-    _next_handle += 1
-    _handles[h] = obj
+    with _HANDLES_LOCK:
+        h = _next_handle
+        _next_handle += 1
+        _handles[h] = obj
     return h
+
+
+def _unregister(handle) -> None:
+    with _HANDLES_LOCK:
+        del _handles[handle]
+
+
+_HANDLE_KINDS = {_DatasetEntry: "Dataset", _BoosterEntry: "Booster",
+                 _ServeEntry: "Serve"}
 
 
 def _get(handle, cls):
     obj = _handles.get(handle)
     if not isinstance(obj, cls):
-        kind = "Dataset" if cls is _DatasetEntry else "Booster"
+        kind = _HANDLE_KINDS.get(cls, "object")
         raise LightGBMError(f"invalid {kind} handle: {handle!r}")
     return obj
 
@@ -229,7 +253,7 @@ def LGBM_DatasetGetFeatureNames(handle, out_strs: Ref, out_len: Ref):
 @_api
 def LGBM_DatasetFree(handle):
     _get(handle, _DatasetEntry)
-    del _handles[handle]
+    _unregister(handle)
 
 
 @_api
@@ -332,7 +356,7 @@ def LGBM_BoosterLoadModelFromString(model_str, out_num_iterations: Ref,
 @_api
 def LGBM_BoosterFree(handle):
     _get(handle, _BoosterEntry)
-    del _handles[handle]
+    _unregister(handle)
 
 
 @_api
@@ -479,12 +503,8 @@ def LGBM_BoosterPredictForMat(handle, data, data_type, nrow, ncol,
                    num_iteration, out_len, out_result)
 
 
-@_api
-def LGBM_BoosterPredictForCSR(handle, indptr, indptr_type, indices, data,
-                              data_type, nindptr, nelem, num_col,
-                              predict_type, num_iteration, parameter,
-                              out_len: Ref, out_result):
-    b = _get(handle, _BoosterEntry)
+def _densify_csr(indptr, indptr_type, indices, data, data_type, nindptr,
+                 num_col) -> np.ndarray:
     indptr = _check_array(indptr, "indptr", indptr_type,
                           (C_API_DTYPE_INT32, C_API_DTYPE_INT64))
     data = _check_array(data, "data", data_type,
@@ -496,6 +516,17 @@ def LGBM_BoosterPredictForCSR(handle, indptr, indptr_type, indices, data,
     rows = np.repeat(np.arange(nrow, dtype=np.int64), counts)
     nnz = len(rows)
     mat[rows, indices[:nnz]] = np.asarray(data[:nnz], np.float64)
+    return mat
+
+
+@_api
+def LGBM_BoosterPredictForCSR(handle, indptr, indptr_type, indices, data,
+                              data_type, nindptr, nelem, num_col,
+                              predict_type, num_iteration, parameter,
+                              out_len: Ref, out_result):
+    b = _get(handle, _BoosterEntry)
+    mat = _densify_csr(indptr, indptr_type, indices, data, data_type,
+                       nindptr, num_col)
     _predict_dense(b.gbdt, mat, predict_type, num_iteration, out_len,
                    out_result)
 
@@ -543,6 +574,73 @@ def LGBM_BoosterFeatureImportance(handle, num_iteration, importance_type,
     imp = b.gbdt.feature_importance(
         "split" if importance_type == 0 else "gain", num_iteration)
     out_results[:len(imp)] = imp
+
+
+# ---------------------------------------------------------------------------
+# Prediction-server functions (lightgbm_tpu extension, not in the
+# reference ABI): a hot-swap packed-ensemble predictor behind an opaque
+# handle, so the windowed harness scores every request against the
+# CURRENT model and atomically replaces it after each retrain
+# (docs/Serving.md).
+# ---------------------------------------------------------------------------
+
+
+@_api
+def LGBM_ServeCreate(booster_handle, parameters, out: Ref):
+    """Create a PredictionServer seeded from a booster.  Recognized
+    parameters: ``num_iteration_predict`` (served tree slice) and the
+    pass-through extras ``serve_max_batch`` / ``serve_max_wait_ms``
+    (micro-batching queue configuration)."""
+    b = _get(booster_handle, _BoosterEntry)
+    cfg = _parse_params(parameters)
+    from .serve import PredictionServer
+    server = PredictionServer(
+        b.gbdt,
+        num_iteration=int(getattr(cfg, "num_iteration_predict", -1)),
+        max_batch=int(cfg.extra.get("serve_max_batch", 8192)),
+        max_wait_ms=float(cfg.extra.get("serve_max_wait_ms", 2.0)))
+    out.value = _register(_ServeEntry(server))
+
+
+@_api
+def LGBM_ServeSwap(serve_handle, booster_handle):
+    """Atomically point the server at ``booster_handle``'s current
+    model (the retrain-window hand-off)."""
+    s = _get(serve_handle, _ServeEntry)
+    b = _get(booster_handle, _BoosterEntry)
+    s.server.swap(b.gbdt)
+
+
+@_api
+def LGBM_ServeCalcNumPredict(serve_handle, num_row, out_len: Ref):
+    s = _get(serve_handle, _ServeEntry)
+    out_len.value = int(num_row) * s.server.packed.num_model
+
+
+@_api
+def LGBM_ServePredictForCSR(serve_handle, indptr, indptr_type, indices,
+                            data, data_type, nindptr, nelem, num_col,
+                            predict_type, out_len: Ref, out_result):
+    """Score CSR rows against the server's CURRENT model in one packed
+    device dispatch.  Supports NORMAL and RAW_SCORE predict types."""
+    s = _get(serve_handle, _ServeEntry)
+    if predict_type not in (C_API_PREDICT_NORMAL,
+                            C_API_PREDICT_RAW_SCORE):
+        raise LightGBMError("LGBM_ServePredictForCSR supports NORMAL "
+                            "and RAW_SCORE predict types only")
+    mat = _densify_csr(indptr, indptr_type, indices, data, data_type,
+                       nindptr, num_col)
+    res = s.server.predict(
+        mat, raw_score=(predict_type == C_API_PREDICT_RAW_SCORE))
+    flat = np.asarray(res, np.float64).reshape(-1)
+    out_result[:len(flat)] = flat
+    out_len.value = len(flat)
+
+
+@_api
+def LGBM_ServeFree(serve_handle):
+    _get(serve_handle, _ServeEntry).server.stop()
+    _unregister(serve_handle)
 
 
 # ---------------------------------------------------------------------------

@@ -129,6 +129,46 @@ def booster_predict_for_csr(handle, indptr_mv, indptr_type, indices_mv,
     return int(out_len.value)
 
 
+def serve_create(booster_handle, params):
+    out = C.Ref()
+    with obs.span("capi.serve_create", cat="capi"):
+        _call(C.LGBM_ServeCreate, booster_handle, params, out)
+    return int(out.value)
+
+
+def serve_swap(serve_handle, booster_handle):
+    # one swap per retrain window: the server atomically adopts the
+    # freshly trained booster's packed ensemble
+    with obs.span("capi.serve_swap", cat="capi"):
+        _call(C.LGBM_ServeSwap, serve_handle, booster_handle)
+
+
+def serve_calc_num_predict(serve_handle, num_row):
+    out = C.Ref()
+    _call(C.LGBM_ServeCalcNumPredict, serve_handle, int(num_row), out)
+    return int(out.value)
+
+
+def serve_predict_for_csr(serve_handle, indptr_mv, indptr_type,
+                          indices_mv, data_mv, data_type, nindptr,
+                          nelem, num_col, predict_type, out_mv):
+    out_len = C.Ref()
+    out_arr = np.frombuffer(out_mv, np.float64)
+    with obs.span("capi.serve_predict_for_csr", cat="capi",
+                  rows=int(nindptr) - 1):
+        _call(C.LGBM_ServePredictForCSR, serve_handle,
+              _arr(indptr_mv, indptr_type), indptr_type,
+              _arr(indices_mv, C.C_API_DTYPE_INT32),
+              _arr(data_mv, data_type), data_type,
+              int(nindptr), int(nelem), int(num_col), predict_type,
+              out_len, out_arr)
+    return int(out_len.value)
+
+
+def serve_free(serve_handle):
+    _call(C.LGBM_ServeFree, serve_handle)
+
+
 def booster_save_model(handle, start_iteration, num_iteration, filename):
     _call(C.LGBM_BoosterSaveModel, handle, start_iteration,
           num_iteration, filename)

@@ -252,6 +252,12 @@ class Config:
             raise ValueError(f"unknown wave_plan: {self.wave_plan}")
         self.wave_plan = wp
 
+        dp = str(self.device_predict).strip().lower()
+        if dp not in ("auto", "force", "off"):
+            raise ValueError(f"unknown device_predict: "
+                             f"{self.device_predict}")
+        self.device_predict = dp
+
     # -- misc -------------------------------------------------------------
     def to_dict(self) -> Dict[str, Any]:
         d = {p: getattr(self, p) for p in PARAM_BY_NAME}

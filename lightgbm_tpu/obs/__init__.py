@@ -283,7 +283,9 @@ def snapshot() -> Dict:
 
 def summary() -> Dict:
     """Compact digest for embedding in bench JSON lines: recompile
-    counts per jitted fn, iteration p95, peak device memory."""
+    counts per jitted fn, iteration p95, peak device memory, and —
+    when the serving path ran — predict-latency percentiles + swap
+    counts."""
     snap = STATE.registry.snapshot()
     iter_stat = snap["timings"].get("train.iter")
     compile_total = sum(v["compiles"] for v in snap["jit"].values())
@@ -298,6 +300,15 @@ def summary() -> Dict:
             "device.peak_bytes_in_use"),
         "events_recorded": len(STATE.trace),
     }
+    serve_stat = snap["timings"].get("serve.predict")
+    if serve_stat:
+        out["serve"] = {
+            "predicts": serve_stat["count"],
+            "predict_p50_ms": round(serve_stat["p50_s"] * 1e3, 3),
+            "predict_p95_ms": round(serve_stat["p95_s"] * 1e3, 3),
+            "swaps": snap["counters"].get("serve.swaps", 0),
+            "rows": snap["counters"].get("serve.rows", 0),
+        }
     return out
 
 

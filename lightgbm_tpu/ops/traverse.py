@@ -7,6 +7,12 @@ level (gather node metadata, decode the feature bin from the group slot,
 branch).  Terminates at the true tree depth.  Used for validation-score
 updates, DART score subtraction and out-of-bag score updates — places where
 the training partition is unavailable.
+
+This is the TRAINING-side traversal: one tree per dispatch over the binned
+matrix, which needs the live dataset's bin mappers.  Batch prediction and
+serving route through ``lightgbm_tpu/serve/packed.py`` instead — the whole
+ensemble packed into flat arrays keyed on RAW feature values, one dispatch
+for any (rows x trees) batch, no dataset required (docs/Serving.md).
 """
 
 from __future__ import annotations

@@ -428,6 +428,27 @@ PARAM_SCHEMA: Sequence[Param] = (
             "no monotone constraints/forced splits/renew-tree objectives); "
             "off = always use the host-driven learner",
        section="device"),
+    _p("device_predict", str, "auto", ("tpu_device_predict",),
+       check="auto/force/off",
+       desc="routing for batch prediction (GBDT.predict_raw): auto = "
+            "the packed-forest device kernel (serve/packed.py: whole "
+            "ensemble flattened into padded device arrays, one jitted "
+            "dispatch per batch, works for file-loaded models) when the "
+            "batch has at least device_predict_min_rows rows, host tree "
+            "walk below; force = always the device kernel; off = always "
+            "the host walk. Row-wise pred_early_stop always takes the "
+            "host path. Leaf routing is bit-identical between the two; "
+            "accumulated values differ ~1e-6 relative (float32 device "
+            "accumulation, docs/Serving.md)", section="device"),
+    _p("device_predict_min_rows", int, 65536, (),
+       check=">= 0",
+       desc="batch size at which device_predict=auto switches from the "
+            "host tree walk to the packed-forest device kernel: below "
+            "it the host walk wins on latency (no transfer, no "
+            "dispatch), above it the single fused device dispatch wins "
+            "on throughput. Tune per deployment; the PredictionServer "
+            "(lightgbm_tpu.serve) always uses the device kernel",
+       section="device"),
     _p("fused_chunk", int, 20, (),
        check=">= 0",
        desc="boosting iterations fused into ONE device dispatch by the "

@@ -1,0 +1,17 @@
+"""Packed-ensemble device inference + hot-swap prediction serving.
+
+``packed``: flatten a tree slice into one set of padded device arrays
+and route any batch through the whole ensemble in a single jitted
+dispatch (no binning, no ``train_set`` — file-loaded models serve the
+same as freshly trained ones).  ``engine``: a thread-safe
+:class:`~.engine.PredictionServer` with shape-bucketed batch padding,
+optional micro-batching, warmup precompiles and atomic model
+``swap()`` for the retrain-every-window loop.  See docs/Serving.md.
+"""
+
+from .engine import PredictionServer  # noqa: F401
+from .packed import (PackedEnsemble, pack_ensemble, pack_gbdt,  # noqa: F401
+                     predict_leaves, predict_scores, row_bucket)
+
+__all__ = ["PredictionServer", "PackedEnsemble", "pack_ensemble",
+           "pack_gbdt", "predict_leaves", "predict_scores", "row_bucket"]

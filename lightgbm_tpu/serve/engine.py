@@ -1,0 +1,269 @@
+"""Hot-swap prediction server over the packed-forest device kernel.
+
+The fork's serving shape (PAPER.md, ``src/test.cpp``): a window loop
+retrains a fresh booster every N requests while EVERY arriving request
+is scored against the current model.  :class:`PredictionServer` owns
+that read side:
+
+* ``swap(booster)`` atomically replaces the packed ensemble — the
+  expensive part (flatten + device upload) happens before the lock, so
+  in-flight ``predict`` calls never observe a half-built model, and a
+  swap whose pad signature matches the previous model re-dispatches
+  into the already-compiled programs (ZERO retraces — the window loop's
+  steady state);
+* ``predict(rows)`` pads the batch to a pow2 row bucket and runs the
+  whole ensemble in one device dispatch;
+* optional micro-batching (``start()``/``submit(rows)``): tiny
+  per-request batches coalesce up to ``max_batch`` rows or
+  ``max_wait_ms``, amortizing dispatch overhead under concurrent
+  callers;
+* ``warmup(...)`` precompiles the configured row buckets so the first
+  real request never pays a trace+compile.
+
+Telemetry (all under the ``serve.`` prefix, see docs/Observability.md):
+``serve.predict`` / ``serve.queue_wait`` / ``serve.request_latency``
+timings (p50/p95 come from the registry), ``serve.batch_rows`` gauge,
+``serve.swaps`` / ``serve.requests`` / ``serve.rows`` /
+``serve.device_batches`` counters.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from queue import Empty, Queue
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import obs
+from ..utils.log import LightGBMError
+from .packed import PackedEnsemble, pack_gbdt, predict_scores, row_bucket
+
+__all__ = ["PredictionServer"]
+
+
+def _as_gbdt(booster):
+    """Accept a ``basic.Booster``, a raw ``GBDT`` (trained or
+    file-loaded), or a model-file path."""
+    if isinstance(booster, str):
+        from ..boosting.gbdt import GBDT
+        return GBDT.load_model_from_file(booster)
+    return getattr(booster, "_gbdt", booster)
+
+
+class _Model:
+    """One immutable generation of the served model: the packed
+    ensemble plus the output conversion the booster would apply."""
+
+    __slots__ = ("packed", "objective", "objective_str", "average_output",
+                 "n_iters")
+
+    def __init__(self, packed: PackedEnsemble, gbdt):
+        self.packed = packed
+        self.objective = gbdt.objective
+        self.objective_str = gbdt.loaded_objective_str
+        self.average_output = bool(gbdt.average_output)
+        self.n_iters = packed.num_iterations
+
+    def convert(self, raw: np.ndarray, raw_score: bool) -> np.ndarray:
+        """(K, R) raw -> user-facing values, matching GBDT.predict."""
+        if self.average_output:
+            if self.n_iters > 0:
+                raw = raw / self.n_iters
+        elif not raw_score:
+            if self.objective is not None:
+                raw = self.objective.convert_output(raw)
+            elif self.objective_str:
+                from ..boosting.gbdt import _convert_by_name
+                raw = _convert_by_name(self.objective_str, raw)
+        if raw.shape[0] == 1:
+            return raw[0]
+        return raw.T
+
+
+class PredictionServer:
+    """Thread-safe hot-swap predictor over a :class:`PackedEnsemble`.
+
+    ``booster`` may be a ``Booster``, a ``GBDT``, or a model-file path;
+    pass ``None`` to create an empty server and ``swap()`` later.
+    ``num_iteration``/``start_iteration`` select the served tree slice
+    (applied on every swap).  ``max_batch``/``max_wait_ms`` configure
+    the optional micro-batching queue (``start()``/``submit()``).
+    """
+
+    def __init__(self, booster=None, *, num_iteration: int = -1,
+                 start_iteration: int = 0, max_batch: int = 8192,
+                 max_wait_ms: float = 2.0, min_bucket: int = 128):
+        self._lock = threading.Lock()
+        self._model: Optional[_Model] = None
+        self.num_iteration = int(num_iteration)
+        self.start_iteration = int(start_iteration)
+        self.max_batch = int(max_batch)
+        self.max_wait_ms = float(max_wait_ms)
+        self.min_bucket = int(min_bucket)
+        self._queue: Queue = Queue()
+        self._worker: Optional[threading.Thread] = None
+        self._stopping = threading.Event()
+        if booster is not None:
+            self.swap(booster)
+
+    # -- model lifecycle ------------------------------------------------
+    def swap(self, booster) -> bool:
+        """Atomically replace the served model.  Packing and device
+        upload happen OUTSIDE the lock; readers switch between complete
+        generations only.  Returns True when the new model's pad
+        signature matches the previous one — the zero-retrace case the
+        window loop relies on."""
+        gbdt = _as_gbdt(booster)
+        with obs.span("serve.swap", cat="serve"):
+            packed = pack_gbdt(gbdt, self.start_iteration,
+                               self.num_iteration)
+            model = _Model(packed, gbdt)
+            with self._lock:
+                prev = self._model
+                self._model = model
+        same_shape = (prev is not None and
+                      prev.packed.shape_signature()
+                      == packed.shape_signature())
+        obs.inc("serve.swaps")
+        if prev is not None and not same_shape:
+            obs.inc("serve.swap_shape_changes")
+        return same_shape
+
+    def _snapshot(self) -> _Model:
+        with self._lock:
+            model = self._model
+        if model is None:
+            raise LightGBMError("PredictionServer has no model; call "
+                                "swap(booster) first")
+        return model
+
+    @property
+    def packed(self) -> PackedEnsemble:
+        return self._snapshot().packed
+
+    def warmup(self, row_buckets: Sequence[int] = (128, 1024, 8192)
+               ) -> List[int]:
+        """Precompile the traversal program for each pow2 row bucket;
+        returns the bucket list actually compiled.  Idempotent: warm
+        buckets hit the jit cache."""
+        model = self._snapshot()
+        nf = model.packed.num_features
+        done = []
+        for rows in row_buckets:
+            b = row_bucket(int(rows), self.min_bucket)
+            if b in done:
+                continue
+            with obs.span("serve.warmup", cat="serve", rows=b):
+                predict_scores(model.packed, np.zeros((b, nf)),
+                               min_bucket=self.min_bucket)
+            done.append(b)
+        return done
+
+    # -- direct prediction ----------------------------------------------
+    def predict(self, data, raw_score: bool = False) -> np.ndarray:
+        """Score a raw feature matrix against the current model — one
+        device dispatch, row-padded to a pow2 bucket.  Output matches
+        ``Booster.predict``: (rows,) for single-model ensembles,
+        (rows, num_model) for multiclass."""
+        data = np.atleast_2d(np.asarray(data, np.float64))
+        model = self._snapshot()
+        with obs.span("serve.predict", cat="serve",
+                      rows=int(data.shape[0])):
+            obs.set_gauge("serve.batch_rows", int(data.shape[0]))
+            raw = predict_scores(model.packed, data,
+                                 min_bucket=self.min_bucket)
+            out = model.convert(raw, raw_score)
+        obs.inc("serve.requests")
+        obs.inc("serve.rows", int(data.shape[0]))
+        return out
+
+    # -- micro-batching queue -------------------------------------------
+    def start(self) -> "PredictionServer":
+        """Start the micro-batching worker thread (idempotent)."""
+        with self._lock:
+            if self._worker is not None and self._worker.is_alive():
+                return self
+            self._stopping.clear()
+            self._worker = threading.Thread(
+                target=self._drain_loop, name="lgbm-serve", daemon=True)
+            self._worker.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the worker; queued requests are drained first."""
+        with self._lock:
+            worker = self._worker
+            self._worker = None
+        if worker is None:
+            return
+        self._stopping.set()
+        worker.join(timeout=10.0)
+
+    def __enter__(self) -> "PredictionServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+    def submit(self, data, raw_score: bool = False) -> Future:
+        """Enqueue rows for micro-batched prediction; resolves to the
+        same values ``predict`` would return for those rows."""
+        if self._worker is None or not self._worker.is_alive():
+            raise LightGBMError("micro-batching worker not running; "
+                                "call start() (or use predict())")
+        fut: Future = Future()
+        data = np.atleast_2d(np.asarray(data, np.float64))
+        self._queue.put((data, bool(raw_score), fut, time.perf_counter()))
+        return fut
+
+    def _drain_loop(self) -> None:
+        while True:
+            try:
+                first = self._queue.get(timeout=0.05)
+            except Empty:
+                if self._stopping.is_set():
+                    return
+                continue
+            batch = [first]
+            rows = first[0].shape[0]
+            deadline = time.perf_counter() + self.max_wait_ms / 1e3
+            while rows < self.max_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    item = self._queue.get(timeout=remaining)
+                except Empty:
+                    break
+                batch.append(item)
+                rows += item[0].shape[0]
+            self._run_batch(batch)
+
+    def _run_batch(self, batch: List[Tuple]) -> None:
+        now = time.perf_counter()
+        for _, _, _, t0 in batch:
+            obs.observe("serve.queue_wait", now - t0)
+        try:
+            # one dispatch per raw_score flavor present in the batch
+            for flavor in sorted({rs for _, rs, _, _ in batch}):
+                group = [b for b in batch if b[1] == flavor]
+                data = np.concatenate([g[0] for g in group], axis=0) \
+                    if len(group) > 1 else group[0][0]
+                out = self.predict(data, raw_score=flavor)
+                lo = 0
+                for g in group:
+                    hi = lo + g[0].shape[0]
+                    g[2].set_result(out[lo:hi])
+                    lo = hi
+        except Exception as e:   # noqa: BLE001 — futures carry errors
+            for _, _, fut, _ in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+            return
+        done = time.perf_counter()
+        for _, _, _, t0 in batch:
+            obs.observe("serve.request_latency", done - t0)

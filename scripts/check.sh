@@ -39,12 +39,13 @@ step "jaxlint" python -m lightgbm_tpu.tools.jaxlint lightgbm_tpu \
     --baseline jaxlint_baseline.json
 
 # 2b. jaxlint with NO baseline over the modules that are debt-free
-#     today (the stage-plan module ships with zero findings): unlike
-#     step 2 — where a new finding in a file with baselined siblings
-#     still fails but the file's debt can only ratchet down — this step
-#     pins an absolute zero-findings contract for the listed files
+#     today (stage-plan and the whole serve/ subsystem ship with zero
+#     findings): unlike step 2 — where a new finding in a file with
+#     baselined siblings still fails but the file's debt can only
+#     ratchet down — this step pins an absolute zero-findings contract
+#     for the listed files
 step "jaxlint (zero-debt modules)" python -m lightgbm_tpu.tools.jaxlint \
-    lightgbm_tpu/ops/stage_plan.py --no-baseline
+    lightgbm_tpu/ops/stage_plan.py lightgbm_tpu/serve --no-baseline
 
 # 3. the telemetry schema validator validates itself
 step "validate_metrics --self-test" \

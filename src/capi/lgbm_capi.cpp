@@ -343,3 +343,77 @@ extern "C" int LGBM_BoosterSaveModel(BoosterHandle handle,
       num_iteration, filename);
   return none_result(call_adapter("booster_save_model", args));
 }
+
+/* ------------------------------------------------------------------ */
+/* Prediction server (lightgbm_tpu extension)                          */
+/* ------------------------------------------------------------------ */
+
+int LGBM_ServeCreate(
+    const BoosterHandle booster,
+    std::unordered_map<std::string, std::string> parameters,
+    ServeHandle* out) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(Ls)", static_cast<long long>(as_id(booster)),
+      params_string(parameters).c_str());
+  return handle_result(call_adapter("serve_create", args), out);
+}
+
+extern "C" int LGBM_ServeSwap(ServeHandle handle,
+                              const BoosterHandle booster) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(LL)", static_cast<long long>(as_id(handle)),
+      static_cast<long long>(as_id(booster)));
+  return none_result(call_adapter("serve_swap", args));
+}
+
+extern "C" int LGBM_ServeCalcNumPredict(ServeHandle handle, int num_row,
+                                        int64_t* out_len) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(Li)", static_cast<long long>(as_id(handle)), num_row);
+  return int_result(call_adapter("serve_calc_num_predict", args),
+                    out_len);
+}
+
+extern "C" int LGBM_ServePredictForCSR(
+    ServeHandle handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t num_col, int predict_type,
+    int64_t* out_len, double* out_result) {
+  ensure_python();
+  Gil gil;
+  /* the caller pre-allocated out_result to ServeCalcNumPredict's len */
+  int64_t out_cap = 0;
+  {
+    PyObject* cargs = Py_BuildValue(
+        "(Li)", static_cast<long long>(as_id(handle)),
+        static_cast<int>(nindptr - 1));
+    if (int_result(call_adapter("serve_calc_num_predict", cargs),
+                   &out_cap) != 0) {
+      return -1;
+    }
+  }
+  PyObject* args = Py_BuildValue(
+      "(LNiNNiLLLiN)", static_cast<long long>(as_id(handle)),
+      mv_read(indptr, nindptr * dtype_size(indptr_type)), indptr_type,
+      mv_read(indices, nelem * 4),
+      mv_read(data, nelem * dtype_size(data_type)), data_type,
+      static_cast<long long>(nindptr), static_cast<long long>(nelem),
+      static_cast<long long>(num_col), predict_type,
+      mv_write(out_result, out_cap * 8));
+  return int_result(call_adapter("serve_predict_for_csr", args),
+                    out_len);
+}
+
+extern "C" int LGBM_ServeFree(ServeHandle handle) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(L)",
+                                 static_cast<long long>(as_id(handle)));
+  return none_result(call_adapter("serve_free", args));
+}

@@ -74,6 +74,7 @@ static int check(int rc, const char* what) {
 int main() {
   const int rows = 4000;
   BoosterHandle booster = nullptr;
+  ServeHandle server = nullptr;
   bool init = true;
 
   for (int window = 0; window < 2; window++) {
@@ -115,6 +116,14 @@ int main() {
                 target, std::stoi(trainParams["num_iterations"]),
                 /*chunk=*/10, &isFinished),
             "UpdateChunked");
+    }
+    /* serving hand-off: window 0 creates the prediction server, later
+     * windows atomically swap in the freshly trained model (the server
+     * keeps its own packed copy, so the old booster frees safely) */
+    if (server == nullptr) {
+      check(LGBM_ServeCreate(target, trainParams, &server), "ServeCreate");
+    } else {
+      check(LGBM_ServeSwap(server, target), "ServeSwap");
     }
     if (!init) {
       check(LGBM_BoosterFree(booster), "BoosterFree(old)");
@@ -161,10 +170,39 @@ int main() {
                            "signal was not learned\n", acc);
       return 1;
     }
+
+    /* the packed-ensemble server must agree with the booster walk
+     * (float32 device accumulation => small value tolerance) */
+    int64_t slen = 0;
+    check(LGBM_ServeCalcNumPredict(server, rows, &slen),
+          "ServeCalcNumPredict");
+    std::vector<double> sresult(slen);
+    check(LGBM_ServePredictForCSR(
+              server, static_cast<void*>(indptr.data()),
+              C_API_DTYPE_INT32, indices.data(),
+              static_cast<void*>(data.data()), C_API_DTYPE_FLOAT64,
+              indptr.size(), data.size(), HISTFEATURES + 3,
+              C_API_PREDICT_NORMAL, &slen, sresult.data()),
+          "ServePredictForCSR");
+    if (slen != rows) {
+      std::fprintf(stderr, "FAIL serve predict len %lld != %d\n",
+                   static_cast<long long>(slen), rows);
+      return 1;
+    }
+    for (int i = 0; i < rows; i++) {
+      if (std::fabs(sresult[i] - result[i]) > 1e-4) {
+        std::fprintf(stderr,
+                     "FAIL serve/booster mismatch at %d: %f vs %f\n", i,
+                     sresult[i], result[i]);
+        return 1;
+      }
+    }
+    std::printf("window %d: serve predict matches booster\n", window);
     check(LGBM_DatasetFree(trainData), "DatasetFree");
   }
   check(LGBM_BoosterSaveModel(booster, 0, -1, "/tmp/lgbm_capi_smoke.model"),
         "SaveModel");
+  check(LGBM_ServeFree(server), "ServeFree");
   check(LGBM_BoosterFree(booster), "BoosterFree");
   std::printf("native ABI smoke: PASS\n");
   return 0;

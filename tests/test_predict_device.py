@@ -1,9 +1,11 @@
-"""Device-traversal batch prediction matches the host tree walk.
+"""Packed-forest batch prediction matches the host tree walk.
 
-GBDT.predict_raw routes large batches through binning + on-device
-traversal (_predict_raw_device); these tests pin agreement with the
+GBDT.predict_raw routes large batches (``device_predict=auto`` with
+``device_predict_min_rows``, or ``force``) through the packed-ensemble
+device kernel (serve/packed.py); these tests pin agreement with the
 host Tree.predict path — leaf routing exactly, values to float32
-accumulation tolerance — including NaN routing and multiclass.
+accumulation tolerance — including NaN routing and multiclass.  The
+full routing/parity suite lives in tests/test_serve.py.
 """
 
 import numpy as np
@@ -26,40 +28,63 @@ def _train(params, x, y, n_iters=10):
     return bst
 
 
-def _compare(bst, xq, monkeypatch):
+def _compare(bst, xq):
+    bst.config.device_predict = "off"
     host = bst.predict_raw(xq.astype(np.float64))
-    monkeypatch.setattr(type(bst), "DEVICE_PREDICT_ROWS", 1)
+    bst.config.device_predict = "force"
     dev = bst.predict_raw(xq.astype(np.float64))
+    bst.config.device_predict = "auto"
     np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-6)
 
 
-def test_device_predict_matches_host_binary(monkeypatch):
+def test_device_predict_matches_host_binary():
     rng = np.random.default_rng(4)
     x = rng.standard_normal((3000, 8)).astype(np.float32)
     y = (x[:, 0] + np.abs(x[:, 1]) > 0.4).astype(np.float32)
     bst = _train({"objective": "binary"}, x, y)
     xq = rng.standard_normal((500, 8)).astype(np.float64)
     xq[rng.random(xq.shape) < 0.1] = np.nan   # exercise missing routing
-    _compare(bst, xq, monkeypatch)
+    _compare(bst, xq)
 
 
-def test_device_predict_matches_host_multiclass(monkeypatch):
+def test_device_predict_matches_host_multiclass():
     rng = np.random.default_rng(5)
     x = rng.standard_normal((2500, 6)).astype(np.float32)
     y = (np.digitize(x[:, 0] + 0.5 * x[:, 1],
                      [-0.5, 0.5])).astype(np.float32)
     bst = _train({"objective": "multiclass", "num_class": 3}, x, y, 6)
     xq = rng.standard_normal((400, 6)).astype(np.float64)
-    _compare(bst, xq, monkeypatch)
+    _compare(bst, xq)
 
 
-def test_device_predict_respects_iteration_window(monkeypatch):
+def test_device_predict_respects_iteration_window():
     rng = np.random.default_rng(6)
     x = rng.standard_normal((2000, 5)).astype(np.float32)
     y = (x[:, 0] > 0).astype(np.float32)
     bst = _train({"objective": "binary"}, x, y, 8)
     xq = rng.standard_normal((300, 5)).astype(np.float64)
+    bst.config.device_predict = "off"
     host = bst.predict_raw(xq, num_iteration=3, start_iteration=2)
-    monkeypatch.setattr(type(bst), "DEVICE_PREDICT_ROWS", 1)
+    bst.config.device_predict = "force"
     dev = bst.predict_raw(xq, num_iteration=3, start_iteration=2)
+    np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-6)
+
+
+def test_device_predict_min_rows_param_routes():
+    """The documented param replaces the old DEVICE_PREDICT_ROWS class
+    constant: auto routing obeys it in both directions."""
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((1500, 5)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    bst = _train({"objective": "binary",
+                  "device_predict_min_rows": 100}, x, y, 4)
+    assert bst.config.device_predict_min_rows == 100
+    xq = rng.standard_normal((200, 5)).astype(np.float64)
+    assert bst._device_predict_wanted(200, None)          # >= threshold
+    assert not bst._device_predict_wanted(99, None)       # below it
+    assert not bst._device_predict_wanted(200, (1, None))  # early stop
+    # and the routed results agree
+    dev = bst.predict_raw(xq)
+    bst.config.device_predict = "off"
+    host = bst.predict_raw(xq)
     np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,351 @@
+"""Parity + hot-swap suite for the packed-ensemble serving subsystem.
+
+Pins the acceptance contract of ``lightgbm_tpu/serve/`` (docs/
+Serving.md): leaf ROUTING bit-identical to the host ``Tree.predict_leaf``
+walk across numerical ``<=`` splits, NaN/zero missing default
+directions, categorical bitsets, multiclass and iteration slicing;
+file-loaded (no ``train_set``) Boosters on the device path; one device
+dispatch per batch; and the window loop's zero-retrace ``swap()``.
+"""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import basic as lgb_basic
+from lightgbm_tpu.boosting import create_boosting
+from lightgbm_tpu.boosting.gbdt import GBDT
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.data.dataset import BinnedDataset
+from lightgbm_tpu.serve import (PredictionServer, pack_ensemble, pack_gbdt,
+                                predict_leaves, predict_scores)
+
+
+def _train(params, x, y, n_iters=8, categorical=()):
+    cfg = Config({"verbosity": -1, "device_growth": "on",
+                  "num_leaves": 15, "min_data_in_leaf": 5, **params})
+    ds = BinnedDataset.construct_from_matrix(x, cfg, list(categorical))
+    ds.metadata.set_label(y)
+    bst = create_boosting(cfg)
+    bst.init_train(ds)
+    for _ in range(n_iters):
+        if bst.train_one_iter():
+            break
+    bst._flush_pending()
+    return bst
+
+
+def _host_leaves(models, xq):
+    return np.stack([t.predict_leaf(xq) for t in models], axis=1) \
+        if models else np.zeros((xq.shape[0], 0), np.int32)
+
+
+def _assert_parity(bst, xq, start=0, num=-1):
+    """Exact leaf routing + value tolerance for a tree slice."""
+    total = bst.num_iterations()
+    end = total if num <= 0 else min(start + num, total)
+    k = bst.num_model
+    pe = pack_ensemble(bst.models, k, start_iteration=start,
+                       num_iteration=num,
+                       num_features=bst.max_feature_idx + 1)
+    leaves = predict_leaves(pe, xq)
+    host = _host_leaves(bst.models[start * k:end * k], xq)
+    np.testing.assert_array_equal(leaves, host)
+    bst.config.device_predict = "off"
+    raw_host = bst.predict_raw(xq, num_iteration=num, start_iteration=start)
+    raw_dev = predict_scores(pe, xq)
+    np.testing.assert_allclose(raw_dev, raw_host, rtol=1e-5, atol=1e-6)
+
+
+def test_packed_parity_numerical_nan():
+    """Numerical <= splits with NaNs in train AND query: exact default-
+    direction routing (missing type NaN)."""
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((3000, 8)).astype(np.float32)
+    x[rng.random(x.shape) < 0.05] = np.nan
+    y = (np.nan_to_num(x[:, 0]) + np.abs(np.nan_to_num(x[:, 1]))
+         > 0.4).astype(np.float32)
+    bst = _train({"objective": "binary"}, x, y)
+    xq = rng.standard_normal((700, 8)).astype(np.float64)
+    xq[rng.random(xq.shape) < 0.15] = np.nan
+    _assert_parity(bst, xq)
+
+
+def test_packed_parity_zero_missing():
+    """zero_as_missing exercises missing type Zero: |v| <= 1e-35 takes
+    the default direction, including exact zeros in the query."""
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((3000, 6)).astype(np.float32)
+    x[rng.random(x.shape) < 0.3] = 0.0
+    y = (x[:, 0] + x[:, 1] > 0.3).astype(np.float32)
+    bst = _train({"objective": "binary", "zero_as_missing": True}, x, y)
+    xq = rng.standard_normal((600, 6)).astype(np.float64)
+    xq[rng.random(xq.shape) < 0.3] = 0.0
+    xq[rng.random(xq.shape) < 0.05] = 1e-40   # inside the zero window
+    _assert_parity(bst, xq)
+
+
+def test_packed_parity_categorical():
+    """Categorical bitset splits: member/non-member/unseen/negative/NaN
+    category values all route exactly."""
+    rng = np.random.default_rng(13)
+    n = 4000
+    cat = rng.integers(0, 12, n)
+    x = np.column_stack([
+        cat.astype(np.float32),
+        rng.standard_normal(n).astype(np.float32),
+        rng.standard_normal(n).astype(np.float32)])
+    effect = np.asarray([2.0, -1.0, 0.5, 3.0, -2.0, 0.0,
+                         1.5, -0.5, 2.5, -1.5, 0.7, -2.5])
+    y = (effect[cat] + x[:, 1] + 0.1 * rng.standard_normal(n)) \
+        .astype(np.float32)
+    bst = _train({"objective": "regression", "num_leaves": 31,
+                  "min_data_in_leaf": 40, "min_gain_to_split": 1e-3},
+                 x, y, n_iters=5, categorical=[0])
+    assert any(t.num_cat > 0 for t in bst.models)
+    xq = np.column_stack([
+        rng.integers(-3, 40, 900).astype(np.float64),   # incl. unseen
+        rng.standard_normal(900),
+        rng.standard_normal(900)])
+    xq[rng.random(900) < 0.1, 0] = np.nan
+    _assert_parity(bst, xq)
+
+
+def test_packed_parity_multiclass_and_slicing():
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((2500, 6)).astype(np.float32)
+    y = (np.digitize(x[:, 0] + 0.5 * x[:, 1],
+                     [-0.5, 0.5])).astype(np.float32)
+    bst = _train({"objective": "multiclass", "num_class": 3}, x, y, 6)
+    assert bst.num_model == 3
+    xq = rng.standard_normal((400, 6)).astype(np.float64)
+    _assert_parity(bst, xq)                      # full model
+    _assert_parity(bst, xq, start=2, num=3)      # interior slice
+    _assert_parity(bst, xq, start=4, num=-1)     # open-ended tail
+
+
+def test_packed_file_loaded_booster_serves_on_device():
+    """The whole point of raw-value packing: a Booster loaded from a
+    model STRING (no train_set, no bin mappers) takes the device path
+    and matches its own host walk exactly."""
+    rng = np.random.default_rng(9)
+    x = rng.standard_normal((2500, 7)).astype(np.float32)
+    y = (x[:, 0] - x[:, 2] > 0.1).astype(np.float32)
+    bst = _train({"objective": "binary"}, x, y)
+    loaded = GBDT.load_model_from_string(bst.model_to_string())
+    assert loaded.train_set is None
+    xq = rng.standard_normal((500, 7)).astype(np.float64)
+    xq[rng.random(xq.shape) < 0.1] = np.nan
+    pe = pack_gbdt(loaded)
+    np.testing.assert_array_equal(predict_leaves(pe, xq),
+                                  _host_leaves(loaded.models, xq))
+    loaded.config.device_predict = "force"
+    dev = loaded.predict_raw(xq)
+    loaded.config.device_predict = "off"
+    host = loaded.predict_raw(xq)
+    np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-6)
+
+
+def test_pred_leaf_honors_start_iteration():
+    """Regression: predict(pred_leaf=True) used to slice trees
+    [0, num_iteration) and ignore start_iteration, while predict_raw
+    honored it."""
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((2000, 5)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    bst = _train({"objective": "binary"}, x, y, 8)
+    xq = rng.standard_normal((150, 5)).astype(np.float64)
+    leaves = bst.predict(xq, pred_leaf=True, num_iteration=3,
+                         start_iteration=2)
+    assert leaves.shape == (150, 3)
+    np.testing.assert_array_equal(leaves,
+                                  _host_leaves(bst.models[2:5], xq))
+    # default slice unchanged: all trees from 0
+    full = bst.predict(xq, pred_leaf=True)
+    assert full.shape == (150, len(bst.models))
+
+
+def test_server_predict_matches_booster_predict():
+    """PredictionServer applies the same output conversion as
+    Booster.predict (sigmoid here), from any of Booster / GBDT / path."""
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((2500, 6))
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float64)
+    ds = lgb_basic.Dataset(x, label=y,
+                           params={"objective": "binary",
+                                   "verbosity": -1, "num_leaves": 15})
+    booster = lgb_basic.Booster(params={"objective": "binary",
+                                        "verbosity": -1,
+                                        "num_leaves": 15}, train_set=ds)
+    for _ in range(6):
+        booster.update()
+    xq = rng.standard_normal((300, 6))
+    server = PredictionServer(booster)
+    got = server.predict(xq)
+    want = booster.predict(xq)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    raw = server.predict(xq, raw_score=True)
+    want_raw = booster.predict(xq, raw_score=True)
+    np.testing.assert_allclose(raw, want_raw, rtol=1e-5, atol=1e-6)
+
+
+def _window_booster(seed, n_iters=4):
+    """Same-config retrain windows over fresh data.  max_depth caps the
+    structural depth inside one pow2 pad bucket and the strong signal
+    fills all 15 leaves, so every window packs to identical pads."""
+    wrng = np.random.default_rng(seed)
+    x = wrng.standard_normal((2000, 8)).astype(np.float32)
+    y = (x[:, 0] + np.abs(x[:, 1]) > 0.5).astype(np.float32)
+    return _train({"objective": "binary", "num_leaves": 15,
+                   "max_depth": 6}, x, y, n_iters)
+
+
+def test_server_hot_swap_zero_retraces():
+    """The cache-admission steady state: same-shaped retrain windows
+    swap into the server with ZERO new traces/compiles (obs jit
+    counters over the packed traversal program), and every predict is
+    ONE device dispatch."""
+    from lightgbm_tpu import obs
+
+    was_enabled = obs.enabled()
+    obs.configure(enabled=True)
+    try:
+        reg = obs.registry()
+        server = PredictionServer(_window_booster(1))
+        xq = np.random.default_rng(0).standard_normal((300, 8))
+        server.predict(xq)
+
+        def compiles():
+            return sum(v["compiles"]
+                       for v in reg.snapshot()["jit"].values())
+
+        warm = compiles()
+        swaps0 = reg.counter("serve.swaps")
+        batches0 = reg.counter("serve.device_batches")
+        # window 2 and 3: same config + same shapes -> same pads
+        for seed in (2, 3):
+            assert server.swap(_window_booster(seed)) is True
+            server.predict(xq)
+        assert compiles() == warm, reg.snapshot()["jit"]
+        assert reg.counter("serve.swaps") == swaps0 + 2
+        # one device dispatch per predict call
+        assert reg.counter("serve.device_batches") == batches0 + 2
+        # different row counts inside one pow2 bucket (257..512 all pad
+        # to 512, like the warm 300-row batch) reuse the program
+        server.predict(xq[:260])
+        server.predict(xq[:290])
+        assert compiles() == warm
+        # a DIFFERENT tree count changes the pad signature: the swap
+        # reports it and the next predict may retrace
+        assert server.swap(_window_booster(4, n_iters=9)) is False
+        assert reg.counter("serve.swap_shape_changes") >= 1
+    finally:
+        if not was_enabled:
+            obs.configure(enabled=False)
+
+
+def test_server_microbatch_queue():
+    """submit() coalesces requests and resolves each future to exactly
+    what predict() returns for those rows."""
+    rng = np.random.default_rng(7)
+    server = PredictionServer(_window_booster(11), max_batch=4096,
+                              max_wait_ms=5.0)
+    queries = [rng.standard_normal((n, 8)) for n in (17, 64, 33)]
+    with server:
+        futures = [server.submit(q) for q in queries]
+        got = [f.result(timeout=30) for f in futures]
+    for q, g in zip(queries, got):
+        np.testing.assert_allclose(g, server.predict(q),
+                                   rtol=1e-6, atol=1e-7)
+    with pytest.raises(Exception):
+        server.submit(queries[0])   # worker stopped
+
+
+def test_server_accepts_model_file(tmp_path):
+    bst = _window_booster(21)
+    path = str(tmp_path / "model.txt")
+    bst.save_model_to_file(path)
+    server = PredictionServer(path)
+    xq = np.random.default_rng(1).standard_normal((100, 8))
+    bst.config.device_predict = "off"
+    want = bst.predict(xq)
+    np.testing.assert_allclose(server.predict(xq), want,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_serve_capi_roundtrip():
+    """The LGBM_Serve* C-API surface: create from a trained booster,
+    predict CSR through the server, swap, free."""
+    import scipy.sparse as sp
+
+    from lightgbm_tpu import c_api as C
+
+    rng = np.random.default_rng(17)
+    x = sp.random(3000, 20, density=0.3, random_state=rng,
+                  data_rvs=lambda k: rng.standard_normal(k)).tocsr()
+    y = (np.asarray(x[:, :4].sum(axis=1)).ravel() > 0.2) \
+        .astype(np.float32)
+    params = "objective=binary num_leaves=15 verbosity=-1"
+
+    def check(rc):
+        assert rc == 0, C.LGBM_GetLastError()
+
+    ds = C.Ref()
+    check(C.LGBM_DatasetCreateFromCSR(
+        x.indptr, C.C_API_DTYPE_INT32, x.indices, x.data,
+        C.C_API_DTYPE_FLOAT64, len(x.indptr), len(x.data), 20, params,
+        None, ds))
+    check(C.LGBM_DatasetSetField(ds.value, "label", y, len(y),
+                                 C.C_API_DTYPE_FLOAT32))
+    bst = C.Ref()
+    check(C.LGBM_BoosterCreate(ds.value, params, bst))
+    fin = C.Ref()
+    check(C.LGBM_BoosterUpdateChunked(bst.value, 5, 5, fin))
+
+    srv = C.Ref()
+    check(C.LGBM_ServeCreate(bst.value, params, srv))
+    nq = 400
+    xq = x[:nq]
+    out_len = C.Ref()
+    check(C.LGBM_ServeCalcNumPredict(srv.value, nq, out_len))
+    assert out_len.value == nq
+    result = np.zeros(nq, np.float64)
+    check(C.LGBM_ServePredictForCSR(
+        srv.value, xq.indptr, C.C_API_DTYPE_INT32, xq.indices, xq.data,
+        C.C_API_DTYPE_FLOAT64, len(xq.indptr), len(xq.data), 20,
+        C.C_API_PREDICT_NORMAL, out_len, result))
+    assert out_len.value == nq
+    # must match the booster's own CSR predict path (value tolerance:
+    # f32 device accumulation vs the host walk)
+    ref = np.zeros(nq, np.float64)
+    check(C.LGBM_BoosterPredictForCSR(
+        bst.value, xq.indptr, C.C_API_DTYPE_INT32, xq.indices, xq.data,
+        C.C_API_DTYPE_FLOAT64, len(xq.indptr), len(xq.data), 20,
+        C.C_API_PREDICT_NORMAL, 0, params, out_len, ref))
+    np.testing.assert_allclose(result, ref, rtol=1e-5, atol=1e-6)
+    # swap to the same booster (same shapes) and free everything
+    check(C.LGBM_ServeSwap(srv.value, bst.value))
+    check(C.LGBM_ServeFree(srv.value))
+    assert C.LGBM_ServePredictForCSR(
+        srv.value, xq.indptr, C.C_API_DTYPE_INT32, xq.indices, xq.data,
+        C.C_API_DTYPE_FLOAT64, len(xq.indptr), len(xq.data), 20,
+        C.C_API_PREDICT_NORMAL, out_len, result) != 0   # stale handle
+    check(C.LGBM_BoosterFree(bst.value))
+    check(C.LGBM_DatasetFree(ds.value))
+
+
+def test_packed_empty_and_stump_models():
+    """Degenerate shapes: zero query rows, stump-only models."""
+    rng = np.random.default_rng(30)
+    x = rng.standard_normal((500, 4)).astype(np.float32)
+    y = np.zeros(500, np.float32)   # constant label -> stumps
+    bst = _train({"objective": "regression",
+                  "boost_from_average": True}, x, y, 2)
+    xq = rng.standard_normal((50, 4))
+    pe = pack_gbdt(bst)
+    bst.config.device_predict = "off"
+    host = bst.predict_raw(xq)
+    np.testing.assert_allclose(predict_scores(pe, xq), host,
+                               rtol=1e-6, atol=1e-7)
+    # zero rows
+    assert predict_scores(pe, np.zeros((0, 4))).shape == (1, 0)
+    assert predict_leaves(pe, np.zeros((0, 4))).shape[0] == 0
