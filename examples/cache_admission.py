@@ -268,6 +268,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     help="write a Chrome-trace/Perfetto timeline of the "
                          "whole windowed session (--trace is taken by "
                          "the input trace file)")
+    ap.add_argument("--compile-cache",
+                    default=os.environ.get("LGBM_TPU_COMPILE_CACHE", ""),
+                    help="persistent XLA compile cache dir "
+                         "(lightgbm_tpu.compile_cache): a restarted "
+                         "harness process re-loads every window's "
+                         "compiled programs from disk instead of "
+                         "recompiling (docs/ColdStart.md); '' disables "
+                         "unless LGBM_TPU_COMPILE_CACHE is set")
     return ap
 
 
@@ -275,10 +283,11 @@ def run(args) -> dict:
     """Run the windowed harness; returns the summary dict (the JSON
     line ``main`` prints).  Importable — ``bench.py --suite cache``
     drives this directly."""
-    from lightgbm_tpu import obs
+    from lightgbm_tpu import compile_cache, obs
     if args.metrics or args.obs_trace:
         obs.configure(enabled=True, metrics_path=args.metrics or None,
                       trace_path=args.obs_trace or None)
+    compile_cache.configure(getattr(args, "compile_cache", ""))
 
     if args.trace == "synth":
         ids, sizes, costs = synth_trace(args.requests, args.objects)

@@ -131,4 +131,24 @@ LIGHTGBM_C_EXPORT int LGBM_ServePredictForCSR(
 
 LIGHTGBM_C_EXPORT int LGBM_ServeFree(ServeHandle handle);
 
+/* ---------------------------------------------------------------------
+ * AOT compile warmup (lightgbm_tpu extension, not in the fork's ABI):
+ * precompile the declared (rows, features, parameters) training /
+ * serving program families into the persistent XLA compile cache
+ * (parameters key compile_cache_dir, or env LGBM_TPU_COMPILE_CACHE),
+ * so a deployment's FIRST real retrain window / first large predict
+ * batch runs warm.  Call once at container start, before the request
+ * loop; *out_num_compiled returns the number of fresh cache entries
+ * written (0 = the cache was already warm for this declaration).
+ * num_row <= 0 on WarmupServe warms the prediction server's default
+ * row buckets.  See docs/ColdStart.md.
+ * ------------------------------------------------------------------ */
+LIGHTGBM_CPP_EXPORT int LGBM_WarmupTrain(
+    std::unordered_map<std::string, std::string> parameters,
+    int64_t num_row, int32_t num_feature, int* out_num_compiled);
+
+LIGHTGBM_CPP_EXPORT int LGBM_WarmupServe(
+    std::unordered_map<std::string, std::string> parameters,
+    int64_t num_row, int32_t num_feature, int* out_num_compiled);
+
 #endif  /* LIGHTGBM_TPU_C_API_H_ */

@@ -206,6 +206,12 @@ class GBDT:
         # harness this runs once per retrain window, so it must stay
         # additive (cross-window recompile/memory totals are the point)
         obs.configure_from_config(cfg)
+        # persistent XLA compile cache: params/env may point every jit
+        # this booster compiles at an on-disk store, so a fresh process
+        # (the windowed harness restarts, deployments roll) re-loads
+        # executables instead of recompiling (docs/ColdStart.md)
+        from .. import compile_cache
+        compile_cache.configure_from_config(cfg)
         obs.inc("train.init_train")
         obs.instant("init_train", cat="boost",
                     rows=int(train_set.num_data),
@@ -280,7 +286,15 @@ class GBDT:
             if serial and device_growth_eligible(cfg, train_set,
                                                  self.objective,
                                                  self.num_model):
-                self._grower = DeviceGrower(train_set, cfg)
+                # row bucketing needs row-local fused gradients (a
+                # bucket-padded row must not perturb real rows):
+                # lambdarank's query-segment formula opts out
+                bucket_ok = (bool(getattr(cfg, "train_row_bucketing",
+                                          True))
+                             and getattr(self.objective,
+                                         "device_grad_rowwise", True))
+                self._grower = DeviceGrower(train_set, cfg,
+                                            row_bucketing=bucket_ok)
                 log_info("Using on-device tree growth (device_growth="
                          f"{mode})")
                 if str(getattr(cfg, "wave_plan", "auto")).lower() \

@@ -152,15 +152,22 @@ def _get(handle, cls):
     return obj
 
 
-def _parse_params(parameters: Optional[str]) -> Config:
-    """Space-separated key=value string, the C API's parameter format."""
-    kv = {}
+def _tokenize_params(parameters: Optional[str]) -> Dict[str, str]:
+    """The C API's parameter format — space-separated key=value — as a
+    raw dict.  The ONE tokenizer: `_parse_params` builds the Config
+    from it, and explicit-key detection (LGBM_ServeCreate) reads its
+    keys, so the two can never disagree."""
+    kv: Dict[str, str] = {}
     if parameters:
         for tok in str(parameters).split():
             if "=" in tok:
                 k, v = tok.split("=", 1)
                 kv[k] = v
-    return Config(kv)
+    return kv
+
+
+def _parse_params(parameters: Optional[str]) -> Config:
+    return Config(_tokenize_params(parameters))
 
 
 def _check_array(arr, name, dtype_const, allowed):
@@ -593,12 +600,19 @@ def LGBM_ServeCreate(booster_handle, parameters, out: Ref):
     (micro-batching queue configuration)."""
     b = _get(booster_handle, _BoosterEntry)
     cfg = _parse_params(parameters)
+    from .config import resolve_alias
     from .serve import PredictionServer
+    # only an EXPLICIT device_predict_min_rows overrides the server's
+    # adopt-from-booster default (the schema default would mask it)
+    explicit = {resolve_alias(k) for k in _tokenize_params(parameters)}
+    min_rows = (int(cfg.device_predict_min_rows)
+                if "device_predict_min_rows" in explicit else None)
     server = PredictionServer(
         b.gbdt,
         num_iteration=int(getattr(cfg, "num_iteration_predict", -1)),
         max_batch=int(cfg.extra.get("serve_max_batch", 8192)),
-        max_wait_ms=float(cfg.extra.get("serve_max_wait_ms", 2.0)))
+        max_wait_ms=float(cfg.extra.get("serve_max_wait_ms", 2.0)),
+        device_predict_min_rows=min_rows)
     out.value = _register(_ServeEntry(server))
 
 
@@ -641,6 +655,47 @@ def LGBM_ServePredictForCSR(serve_handle, indptr, indptr_type, indices,
 def LGBM_ServeFree(serve_handle):
     _get(serve_handle, _ServeEntry).server.stop()
     _unregister(serve_handle)
+
+
+# ---------------------------------------------------------------------------
+# AOT warmup functions (lightgbm_tpu extension, not in the reference
+# ABI): precompile a deployment's declared (rows, features, config)
+# program families into the persistent XLA compile cache
+# (docs/ColdStart.md) so the first real retrain window / first large
+# predict batch runs warm.  The harness calls these once at container
+# start, before the request loop.
+# ---------------------------------------------------------------------------
+
+
+@_api
+def LGBM_WarmupTrain(parameters, num_row, num_feature,
+                     out_num_compiled: Ref):
+    """Drive the real training path on a synthetic (num_row,
+    num_feature) dataset long enough to compile every program a
+    production run with ``parameters`` dispatches (one fused chunk +
+    any per-iteration remainder).  ``parameters`` should include
+    ``compile_cache_dir`` (or export LGBM_TPU_COMPILE_CACHE) plus the
+    production training params.  Returns the number of fresh
+    persistent-cache entries written (0 = already warm)."""
+    from .warmup import warmup_train
+    cfg = _parse_params(parameters)
+    report = warmup_train(int(num_row), int(num_feature), config=cfg)
+    out_num_compiled.value = int(report["cache_misses"])
+
+
+@_api
+def LGBM_WarmupServe(parameters, num_row, num_feature,
+                     out_num_compiled: Ref):
+    """Precompile the packed-forest traversal family for the declared
+    serving deployment (``num_iterations``/``num_leaves``/``num_class``
+    from ``parameters``; every realizable depth pad).  ``num_row`` <= 0
+    warms the PredictionServer default buckets (128/1024/8192 + the
+    ``device_predict_min_rows`` bucket)."""
+    from .warmup import warmup_serve
+    cfg = _parse_params(parameters)
+    rows = [int(num_row)] if int(num_row) > 0 else []
+    report = warmup_serve(rows, int(num_feature), config=cfg)
+    out_num_compiled.value = int(report["cache_misses"])
 
 
 # ---------------------------------------------------------------------------

@@ -25,7 +25,14 @@ from __future__ import annotations
 import numpy as np
 
 from . import c_api as C
+from . import compile_cache
 from . import obs
+
+# persistent XLA compile cache: the native harness exports
+# LGBM_TPU_COMPILE_CACHE=<dir> and every window's programs load from /
+# persist to disk — a restarted harness process starts warm (the
+# LGBM_WarmupTrain/LGBM_WarmupServe ABI calls pre-fill the same dir)
+compile_cache.configure_from_env()
 
 
 def _arr(mv, dtype_const):
@@ -167,6 +174,22 @@ def serve_predict_for_csr(serve_handle, indptr_mv, indptr_type,
 
 def serve_free(serve_handle):
     _call(C.LGBM_ServeFree, serve_handle)
+
+
+def warmup_train(params, num_row, num_feature):
+    out = C.Ref()
+    with obs.span("capi.warmup_train", cat="capi", rows=int(num_row)):
+        _call(C.LGBM_WarmupTrain, params, int(num_row),
+              int(num_feature), out)
+    return int(out.value)
+
+
+def warmup_serve(params, num_row, num_feature):
+    out = C.Ref()
+    with obs.span("capi.warmup_serve", cat="capi", rows=int(num_row)):
+        _call(C.LGBM_WarmupServe, params, int(num_row),
+              int(num_feature), out)
+    return int(out.value)
 
 
 def booster_save_model(handle, start_iteration, num_iteration, filename):

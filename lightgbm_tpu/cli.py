@@ -226,13 +226,32 @@ def run_refit(cfg: Config):
     log_info("Finished refitting")
 
 
+def run_warmup(cfg: Config):
+    """Ahead-of-time compile warmup (docs/ColdStart.md): precompile the
+    declared (rows, features, config) training + serving program
+    families into the persistent compile cache, so a deployment's first
+    real window runs warm."""
+    from .warmup import run_warmup as _run
+    _run(cfg)
+    log_info("Finished warmup")
+
+
 def main(argv=None):
-    argv = argv if argv is not None else sys.argv[1:]
+    argv = list(argv if argv is not None else sys.argv[1:])
+    # `lightgbm-tpu warmup key=value...` subcommand sugar for task=warmup
+    if argv and argv[0] == "warmup":
+        argv = argv[1:] + ["task=warmup"]
     params = parse_cli_args(argv)
     if not params:
-        print("usage: python -m lightgbm_tpu config=train.conf [key=value...]")
+        print("usage: python -m lightgbm_tpu config=train.conf [key=value...]\n"
+              "       python -m lightgbm_tpu warmup warmup_rows=N "
+              "warmup_features=F [key=value...]")
         return 1
     cfg = Config(params)
+    # every task benefits from the persistent compile cache (train via
+    # init_train too, but predict/convert/warmup configure here)
+    from . import compile_cache
+    compile_cache.configure_from_config(cfg)
     task = cfg.task
     if task == "train":
         run_train(cfg)
@@ -242,6 +261,8 @@ def main(argv=None):
         run_convert_model(cfg)
     elif task in ("refit", "refit_tree"):
         run_refit(cfg)
+    elif task == "warmup":
+        run_warmup(cfg)
     else:
         raise LightGBMError(f"unknown task: {task}")
     return 0

@@ -1,0 +1,260 @@
+"""Persistent XLA compile cache: zero *recompiles* across processes.
+
+BENCH_r05 measured ``warmup_compile_s`` = 239.4 s against 225.5 s of
+timed training — every fresh process pays a full training-run's worth of
+XLA compilation, which is disqualifying for the fork's
+retrain-every-window production story (the harness retrains through the
+C API every window, and deployments restart).  PR 4's ``GrowerPrograms``
+cache already gives zero *retraces* within a process; this module closes
+the cross-process half by activating JAX's persistent compilation cache
+(``jax_compilation_cache_dir``) as a first-class, library-level
+subsystem instead of a bench.py-only env default:
+
+* ``configure(cache_dir)`` — point JAX at an on-disk LRU cache of
+  compiled executables.  Every entry point calls
+  :func:`configure_from_config` / :func:`configure_from_env`
+  (``GBDT.init_train``, the CLI, ``capi_embed`` import,
+  ``PredictionServer``, ``bench.py``, ``examples/cache_admission.py``),
+  so exporting ``LGBM_TPU_COMPILE_CACHE=/path`` warms ANY driver with no
+  code change;
+* the min-compile-time floor is forced to 0 while active: the whole
+  point is a warm cold start, and JAX's default 1 s floor would leave
+  the eager glue ops (score scatter, boost-from-average add, ...) cold —
+  exactly the entries the CI smoke's zero-miss gate
+  (``scripts/check_coldstart.py``) pins;
+* hit/miss telemetry: JAX emits ``/jax/compilation_cache/*`` monitoring
+  events at every compile; :func:`install_listeners` maps them onto obs
+  counters (``compile_cache.hits`` / ``misses`` / ``requests`` and the
+  ``compile_cache.time_saved`` timing) next to the per-signature retrace
+  tracking in ``obs/jit_track.py``, so a run's metrics snapshot shows
+  BOTH layers of the caching story (docs/Observability.md);
+* knobs: ``compile_cache_min_entry_bytes`` (skip tiny entries when a
+  deployment wants a lean cache dir) and ``compile_cache_strict_keys``
+  (include compiler/runtime build metadata in the cache key — the
+  sharing-safety switch for a cache dir mounted across heterogeneous
+  hosts; false hits are impossible either way on identical builds, the
+  strict mode just refuses cross-build reuse instead of trusting the
+  serialized executable's compatibility).
+
+The cache key is XLA's (HLO module + compile options + backend), NOT
+lightgbm_tpu's ``programs_signature`` — so a warmup run only has to
+reproduce the *traced program* (shapes, num_leaves, max_bin, chunk,
+stage plan), not the exact data or regularization values (those are
+traced arguments).  docs/ColdStart.md lists which parameters shape
+traces.
+
+Everything imports ``jax`` lazily: importing this module costs nothing
+and is safe before backend selection.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from . import obs
+
+ENV_VAR = "LGBM_TPU_COMPILE_CACHE"
+_FALSY = ("", "0", "false", "no", "off")
+
+# guarded module state (configure may race between a PredictionServer
+# thread and the training driver)
+_LOCK = threading.Lock()
+_STATE = {"dir": None, "listeners": False}
+
+# own always-on counters (compiles are rare; the lock is uncontended):
+# warmup reports and the CI zero-miss smoke must not depend on the obs
+# registry being enabled.  Mirrored into obs when telemetry is on.
+_COUNTS = {"hits": 0, "misses": 0, "requests": 0,
+           "backend_compile_s": 0.0, "time_saved_s": 0.0}
+
+_EVENT_COUNTERS = {
+    "/jax/compilation_cache/cache_hits": "hits",
+    "/jax/compilation_cache/cache_misses": "misses",
+    "/jax/compilation_cache/compile_requests_use_cache": "requests",
+}
+
+# actual XLA backend-compile seconds this process paid: a persistent-
+# cache hit skips this entirely, so cold/warm runs of the same shapes
+# differ by exactly this component (tracing is Python work the disk
+# cache cannot remove — on CPU backends it dominates the residual, so
+# the coldstart test gates on THIS ratio while the TPU bench gates the
+# wall-clock one)
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def _on_event(event, **kwargs) -> None:
+    key = _EVENT_COUNTERS.get(event)
+    if key is not None:
+        with _LOCK:
+            _COUNTS[key] += 1
+        obs.inc(f"compile_cache.{key}")
+
+
+def _on_duration(event, duration, **kwargs) -> None:
+    if event == _BACKEND_COMPILE_EVENT:
+        with _LOCK:
+            _COUNTS["backend_compile_s"] += float(duration)
+        obs.observe("compile_cache.backend_compile", float(duration))
+    elif event == "/jax/compilation_cache/compile_time_saved_sec":
+        # JAX reports saved = original_compile - retrieval; for sub-ms
+        # executables retrieval can exceed the compile, making this
+        # negative — clamp so the timing histogram keeps its
+        # total >= max invariant (the net saving of such entries is ~0)
+        saved = max(float(duration), 0.0)
+        with _LOCK:
+            _COUNTS["time_saved_s"] += saved
+        obs.observe("compile_cache.time_saved", saved)
+
+
+def install_listeners() -> None:
+    """Register the JAX monitoring listeners (idempotent).  The
+    listeners themselves are two dict lookups per compile and feed the
+    obs registry only while telemetry is enabled."""
+    with _LOCK:
+        if _STATE["listeners"]:
+            return
+        _STATE["listeners"] = True
+    import jax
+
+    jax.monitoring.register_event_listener(_on_event)
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+
+
+def cache_dir() -> Optional[str]:
+    """The directory this module last activated, or None."""
+    return _STATE["dir"]
+
+
+def configure(cache_dir: Optional[str], *,
+              min_entry_bytes: Optional[int] = None,
+              strict_keys: Optional[bool] = None,
+              _pin: bool = True) -> Optional[str]:
+    """Activate the persistent compilation cache at ``cache_dir``.
+
+    Returns the expanded directory (created if missing), or None when
+    ``cache_dir`` is falsy ("", "0", "false", "off" all mean "leave the
+    cache alone" — an env var that disabled it stays disabled).  The
+    compile-seconds/hit/miss listeners install either way, so
+    :func:`counters` works even without a cache dir.
+
+    ``min_entry_bytes`` / ``strict_keys`` are STICKY: ``None`` keeps
+    whatever an earlier configure set (first activation applies the
+    schema defaults 0 / False) — a knob explicitly set through params
+    must survive the env-only reconfigures every entry point performs
+    (``PredictionServer``, the ``capi_embed`` import, later windows).
+
+    Re-configuring with the SAME directory is a cheap no-op; switching
+    directories mid-process resets JAX's internal cache object so later
+    compiles read/write the new location (JAX memoizes the cache handle
+    at first compile).
+    """
+    install_listeners()
+    if cache_dir is None or str(cache_dir).strip().lower() in _FALSY:
+        return None
+    path = os.path.abspath(os.path.expanduser(str(cache_dir)))
+    import jax
+
+    os.makedirs(path, exist_ok=True)   # before any state change: may raise
+    with _LOCK:
+        changed = _STATE["dir"] != path
+        _STATE["dir"] = path
+        if _pin:
+            # every EXPLICIT activation (param, library call, CLI flag)
+            # pins the dir against later env-only reconfigures; only
+            # the env path itself activates unpinned
+            _STATE["pinned"] = True
+        if min_entry_bytes is not None:
+            _STATE["min_entry_bytes"] = int(min_entry_bytes)
+        if strict_keys is not None:
+            _STATE["strict_keys"] = bool(strict_keys)
+        entry_floor = _STATE.get("min_entry_bytes", 0)
+        strict = _STATE.get("strict_keys", False)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_enable_compilation_cache", True)
+    # floor = 0: the warm-cold-start contract needs EVERY executable the
+    # training run dispatches persisted, including sub-second glue ops
+    # (the CI smoke asserts zero misses after an AOT warmup)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                      entry_floor)
+    jax.config.update("jax_compilation_cache_include_metadata_in_key",
+                      strict)
+    if changed:
+        try:
+            from jax._src import compilation_cache as _cc
+            _cc.reset_cache()
+        except Exception:   # pragma: no cover — private API moved
+            pass
+    return path
+
+
+def configure_from_env() -> Optional[str]:
+    """Activate from ``LGBM_TPU_COMPILE_CACHE`` (no-op when unset or
+    falsy) — how the native ``liblgbm_tpu`` harness and the
+    ``PredictionServer`` pick the cache up without a config object.
+
+    A dir explicitly configured (param, library call, CLI flag) wins:
+    once any pinned :func:`configure` activated a directory, this call
+    leaves it alone (otherwise creating a PredictionServer mid-training
+    would flip the process-wide cache back to the env dir and abandon
+    the warm entries).  Never raises: a bad env path (read-only FS,
+    permission) must not take down training/serving over a cache — it
+    logs a warning and degrades to no persistent cache."""
+    with _LOCK:
+        current = _STATE["dir"] if (_STATE["dir"]
+                                    and _STATE.get("pinned")) else None
+    if current:
+        install_listeners()
+        return current
+    try:
+        return configure(os.environ.get(ENV_VAR, ""), _pin=False)
+    except OSError as e:
+        from .utils.log import log_warning
+        log_warning(f"cannot activate the persistent compile cache from "
+                    f"{ENV_VAR}: {e}; continuing without it")
+        return None
+
+
+def configure_from_config(cfg) -> Optional[str]:
+    """Activate from a :class:`~lightgbm_tpu.config.Config`.
+
+    ``compile_cache_dir`` wins when set; otherwise the env var decides
+    the DIR while the config's knobs still apply (sticky — see
+    :func:`configure`).  Called on every ``GBDT.init_train`` — once per
+    retrain window — so it must stay cheap (same-dir reconfigure is a
+    string compare).
+    """
+    path = str(getattr(cfg, "compile_cache_dir", "") or "")
+    # schema defaults (0 / False) equal the sticky initial values, so a
+    # default-valued config passes None = "keep what's set" — only a
+    # non-default knob overrides (and sticks for the process)
+    raw_entry = int(getattr(cfg, "compile_cache_min_entry_bytes", 0) or 0)
+    knobs = dict(
+        min_entry_bytes=raw_entry if raw_entry else None,
+        strict_keys=True if getattr(cfg, "compile_cache_strict_keys",
+                                    False) else None)
+    if not path:
+        path = os.environ.get(ENV_VAR, "")
+        if not path or str(path).strip().lower() in _FALSY:
+            install_listeners()
+            return None
+        try:
+            # dir came from the env: activate unpinned, so a later
+            # explicit dir can still take over
+            return configure(path, _pin=False, **knobs)
+        except OSError as e:
+            from .utils.log import log_warning
+            log_warning(f"cannot activate the persistent compile cache "
+                        f"from {ENV_VAR}: {e}; continuing without it")
+            return None
+    return configure(path, **knobs)
+
+
+def counters() -> dict:
+    """Process-lifetime persistent-cache hit/miss/request counts
+    (independent of the obs registry, which mirrors them as
+    ``compile_cache.*`` counters while telemetry is enabled)."""
+    with _LOCK:
+        return dict(_COUNTS)

@@ -55,6 +55,14 @@ class ObjectiveFunction:
     name = "none"
     is_constant_hessian = False
     is_renew_tree_output = False
+    # whether device_grad's formula is row-local: row i's (grad, hess)
+    # depend only on row i's (score, label, weight), and the output
+    # shape follows the input score shape.  Gates train_row_bucketing's
+    # fused path: bucket-padded rows then produce garbage gradients the
+    # grower's valid mask can safely zero.  Objectives with cross-row
+    # structure (lambdarank's query segments) must set this False — a
+    # padded row could change REAL rows' gradients there.
+    device_grad_rowwise = True
 
     def __init__(self, config):
         self.config = config

@@ -35,6 +35,12 @@ def default_label_gain(n=31) -> List[float]:
 
 class LambdarankNDCG(ObjectiveFunction):
     name = "lambdarank"
+    # gradients are query-segment reductions gathered through the
+    # per-row bucket permutation (inv_perm is sized to the REAL row
+    # count): bucket-padding the score would both break the output
+    # shape and let padding perturb real rows — train_row_bucketing's
+    # fused path must stay off here (ops/grow.py, docs/ColdStart.md)
+    device_grad_rowwise = False
 
     def __init__(self, config):
         super().__init__(config)

@@ -300,6 +300,15 @@ def summary() -> Dict:
             "device.peak_bytes_in_use"),
         "events_recorded": len(STATE.trace),
     }
+    cc_req = snap["counters"].get("compile_cache.requests", 0)
+    if cc_req:
+        saved = snap["timings"].get("compile_cache.time_saved")
+        out["compile_cache"] = {
+            "requests": cc_req,
+            "hits": snap["counters"].get("compile_cache.hits", 0),
+            "misses": snap["counters"].get("compile_cache.misses", 0),
+            "time_saved_s": round(saved["total_s"], 2) if saved else 0.0,
+        }
     serve_stat = snap["timings"].get("serve.predict")
     if serve_stat:
         out["serve"] = {

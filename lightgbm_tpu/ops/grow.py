@@ -449,26 +449,40 @@ class GrowerPrograms:
 
     # ------------------------------------------------------------------
     def _grow_impl(self, binned, binned_t, score, grad, hess, feature_mask,
-                   lr, row_mask, tree_idx, meta, hyper, tables, *,
-                   with_mask):
+                   lr, row_mask, tree_idx, num_valid, meta, hyper, tables,
+                   *, with_mask):
         """One boosting iteration on device.  Returns (new_score, rec_i
         (L-1,5) i32, rec_f (L-1,9) f32, rec_c (L-1,8) i32, num_leaves
         i32, root_value f32, num_waves i32, quant_scales (2,) f32).
         ``lr`` is traced so callbacks may reset the learning rate without
         recompiling; ``tree_idx`` is the global tree index keying the
         quantization rounding noise (unused when grad_quant_bits=0).
-        The binned matrices — like ``meta``/``hyper``/``tables`` — are
-        arguments, not closures: a closed-over array becomes an XLA
-        constant and ships inside the compile request (fatal at 10M-row
-        scale on a remote-compile backend), and argument-passing is what
-        lets the program cache serve every same-shaped dataset."""
+        ``num_valid`` is the REAL row count as a traced i32 scalar:
+        under train_row_bucketing ``self.num_data`` is the pow2 row
+        bucket, and the rows in [num_valid, num_data) are bucket padding
+        that must carry zero gradient/hessian/count — keeping the cutoff
+        traced is what lets ONE compiled program serve every window size
+        in the bucket.  The binned matrices — like ``meta``/``hyper``/
+        ``tables`` — are arguments, not closures: a closed-over array
+        becomes an XLA constant and ships inside the compile request
+        (fatal at 10M-row scale on a remote-compile backend), and
+        argument-passing is what lets the program cache serve every
+        same-shaped dataset."""
         L, W, S = self.num_leaves, self.wave_width, self.num_slots
         n = self.n_pad
         npad_rows = n - self.num_data
 
         grad = jnp.pad(grad, (0, npad_rows))
         hess = jnp.pad(hess, (0, npad_rows))
-        one_f = jnp.where(jnp.arange(n) < self.num_data, 1.0, 0.0)
+        valid_f = jnp.where(jnp.arange(n) < num_valid, 1.0, 0.0)
+        # bucket-pad rows may carry garbage gradients (the fused path's
+        # grad_fn computes them from padded scores/labels): zero them
+        # BEFORE quantization scales / stat columns see them.  For real
+        # rows this is an exact f32 no-op (x * 1.0 == x bitwise), which
+        # keeps the bucketed and unbucketed paths byte-identical.
+        grad = grad * valid_f
+        hess = hess * valid_f
+        one_f = valid_f
         if with_mask:
             # bagging/GOSS: 0/1 in-bag indicator. Out-of-bag rows drop out
             # of histograms and counts (their grad/hess are already zeroed
@@ -479,7 +493,7 @@ class GrowerPrograms:
         gh5, qscales = self._stat_columns(grad, hess, one_f, tree_idx)
         wave_scales = qscales if self.quant_bits else None
 
-        leaf_id0 = jnp.where(jnp.arange(n, dtype=jnp.int32) < self.num_data,
+        leaf_id0 = jnp.where(jnp.arange(n, dtype=jnp.int32) < num_valid,
                              0, -1)
 
         class _S(NamedTuple):
@@ -831,14 +845,16 @@ class GrowerPrograms:
 
         Signature of the returned (raw) program::
 
-            run(binned, binned_t, score, lr, gargs, it0,
+            run(binned, binned_t, score, lr, gargs, it0, num_valid,
                 meta, hyper, tables, grad_fn=fn)
             -> (final_score,
                 (rec_i (K,L-1,5), rec_f (K,L-1,9), rec_c (K,L-1,8),
                  nl (K,), root_value (K,), waves (K,), qscales (K,2)))
 
         ``it0`` is the global iteration index of the chunk's first tree
-        (traced, so resuming mid-run reuses the compiled program).
+        (traced, so resuming mid-run reuses the compiled program);
+        ``num_valid`` is the real row count (traced i32 — score/gargs
+        rows past it are train_row_bucketing pad).
         ``grad_fn(score, gargs) -> (grad, hess)`` comes from
         ``ObjectiveFunction.device_grad`` (pure jnp; all arrays via
         ``gargs``).  Compiled once per (length, grad_fn) pair — callers
@@ -855,8 +871,8 @@ class GrowerPrograms:
             bag_freq, bag_seed = self._bag_freq, self._bag_seed
             bag_frac, bag_npad = self._bag_fraction, self._bag_npad
 
-            def run(binned, binned_t, score, lr, gargs, it0, meta, hyper,
-                    tables, grad_fn):
+            def run(binned, binned_t, score, lr, gargs, it0, num_valid,
+                    meta, hyper, tables, grad_fn):
                 no_mask = jnp.zeros((0,), jnp.float32)
                 its = jnp.arange(length, dtype=jnp.int32) + it0
 
@@ -879,8 +895,8 @@ class GrowerPrograms:
                     (new_score, rec_i, rec_f, rec_c, nl, root, waves,
                      qs) = self._grow_impl(
                         binned, binned_t, sc, g, h, fmask, lr,
-                        bmask if use_bag else no_mask, it, meta, hyper,
-                        tables, with_mask=use_bag)
+                        bmask if use_bag else no_mask, it, num_valid,
+                        meta, hyper, tables, with_mask=use_bag)
                     out = (rec_i, rec_f, rec_c, nl, root, waves, qs)
                     return ((new_score, bmask) if use_bag
                             else new_score), out
@@ -992,7 +1008,7 @@ class DeviceGrower:
     reached through attribute forwarding, so ``grower.hist_cols`` etc.
     keep working."""
 
-    def __init__(self, dataset, config):
+    def __init__(self, dataset, config, row_bucketing=None):
         self.config = config
         self.dataset = dataset
         self.num_data = int(dataset.num_data)
@@ -1003,13 +1019,55 @@ class DeviceGrower:
             while g.num_total_bin > nb:
                 nb *= 2
 
+        # training-shape bucketing: key the program cache (in-process
+        # AND the persistent XLA cache, docs/ColdStart.md) on a pow2 row
+        # bucket instead of the exact row count, so one compiled program
+        # family covers a whole traffic range of retrain-window sizes.
+        # The ladder is histogram.bucket_size — the SAME pad the bagging
+        # buffer uses, so the fused scan's in-scan bagging draw stays
+        # bit-identical to the unbucketed path (the uniform stream's
+        # shape is part of the draw).  The real row count travels as the
+        # traced `num_valid` scalar; bucket-pad rows carry zero
+        # grad/hess/count exactly like the chunk pad, so trees are
+        # byte-identical.  Exceptions: grad_quant_bits keys its
+        # stochastic-rounding stream on the padded shape (the caller
+        # disables bucketing there to keep the quant contract), and a
+        # bucket crossing the striped-count eligibility bound falls back
+        # to exact rows.
+        if row_bucketing is None:
+            row_bucketing = bool(getattr(config, "train_row_bucketing",
+                                         True))
+        bucket = self.num_data
+        if row_bucketing and not int(getattr(config, "grad_quant_bits",
+                                             0) or 0):
+            bucket = bucket_size(max(self.num_data, 1))
+            if bucket >= 2 * COUNT_SPLIT_ROWS:
+                # the pow2 bucket would cross the striped-count
+                # eligibility bound the exact row count still satisfies
+                # (device_growth_eligible checks the REAL rows) — fall
+                # back to exact rows rather than to the host learner.
+                # Say so: an operator counting on one program family
+                # per bucket should see why >16.7M-row windows each
+                # compile their own
+                from ..utils.log import log_info
+                log_info(
+                    f"train_row_bucketing: row bucket {bucket} would "
+                    f"reach the striped-count bound "
+                    f"({2 * COUNT_SPLIT_ROWS}); using exact rows "
+                    f"({self.num_data}) — programs are per-row-count "
+                    f"at this scale")
+                bucket = self.num_data
+        self.row_bucket = int(bucket)
+
         has_cat = bool(np.asarray(dataset.f_is_categorical).any())
         self.programs = get_grower_programs(
-            self.num_data, int(dataset.num_groups), nb,
+            self.row_bucket, int(dataset.num_groups), nb,
             int(dataset.num_features), has_cat, config)
         self._base_signature = programs_signature(
-            self.num_data, int(dataset.num_groups), nb,
+            self.row_bucket, int(dataset.num_groups), nb,
             int(dataset.num_features), has_cat, config)
+        self._num_valid = jnp.asarray(self.num_data, jnp.int32)
+        self._row_pad = self.row_bucket - self.num_data
 
         pad = self.programs.n_pad - self.num_data
         if getattr(dataset, "device_binned", False):
@@ -1070,16 +1128,28 @@ class DeviceGrower:
             lr = self.lr
         obs.inc("grow.dispatches")
         ti = jnp.asarray(tree_idx, jnp.int32)
+        if self._row_pad:
+            # bucket pad: the program's row dim is the pow2 bucket; the
+            # traced num_valid cuts the padding back out of every stat
+            score = jnp.pad(score, (0, self._row_pad))
+            grad = jnp.pad(grad, (0, self._row_pad))
+            hess = jnp.pad(hess, (0, self._row_pad))
+            if row_mask is not None:
+                row_mask = jnp.pad(row_mask, (0, self._row_pad))
         if row_mask is None:
-            return self.programs._grow(
+            out = self.programs._grow(
                 self.binned, self.binned_t, score, grad, hess,
                 feature_mask, jnp.asarray(lr, jnp.float32),
-                jnp.zeros((0,), jnp.float32), ti, self.meta, self.hyper,
-                self.tables)
-        return self.programs._grow_masked(
-            self.binned, self.binned_t, score, grad, hess, feature_mask,
-            jnp.asarray(lr, jnp.float32), row_mask, ti, self.meta,
-            self.hyper, self.tables)
+                jnp.zeros((0,), jnp.float32), ti, self._num_valid,
+                self.meta, self.hyper, self.tables)
+        else:
+            out = self.programs._grow_masked(
+                self.binned, self.binned_t, score, grad, hess,
+                feature_mask, jnp.asarray(lr, jnp.float32), row_mask, ti,
+                self._num_valid, self.meta, self.hyper, self.tables)
+        if self._row_pad:
+            out = (out[0][:self.num_data],) + tuple(out[1:])
+        return out
 
     # ------------------------------------------------------------------
     def fused_train(self, length: int):
@@ -1090,10 +1160,30 @@ class DeviceGrower:
         """
         raw = self.programs.fused_train(length)
         meta, hyper, tables = self.meta, self.hyper, self.tables
+        num_valid, row_pad, real_n = (self._num_valid, self._row_pad,
+                                      self.num_data)
+
+        def _pad_rows(a):
+            # gargs leaves with a leading per-row axis (labels, weights)
+            # stretch to the bucket; padded rows produce garbage
+            # gradients that _grow_impl's valid mask zeroes.  Only sound
+            # for row-local gradient formulas — the boosting layer gates
+            # bucketing on objective.device_grad_rowwise.
+            if (getattr(a, "ndim", 0) >= 1
+                    and a.shape[0] == real_n):
+                return jnp.pad(a, [(0, row_pad)] + [(0, 0)] * (a.ndim - 1))
+            return a
 
         def run(binned, binned_t, score, lr, gargs, it0, grad_fn):
-            return raw(binned, binned_t, score, lr, gargs, it0, meta,
-                       hyper, tables, grad_fn=grad_fn)
+            if row_pad:
+                score = jnp.pad(score, (0, row_pad))
+                gargs = jax.tree_util.tree_map(_pad_rows, gargs)
+            final_score, recs = raw(binned, binned_t, score, lr, gargs,
+                                    it0, num_valid, meta, hyper, tables,
+                                    grad_fn=grad_fn)
+            if row_pad:
+                final_score = final_score[:real_n]
+            return final_score, recs
         return run
 
     # ------------------------------------------------------------------

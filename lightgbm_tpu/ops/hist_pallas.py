@@ -184,7 +184,16 @@ def wave_hist_pallas(binned, leaf_id, ghk, pending, *, g: int, nb: int,
             f"pallas wave-histogram needs rows ({n}) divisible by its "
             f"chunk ({ch}); pad rows to a multiple (LGBM_TPU_CHUNK must "
             f"be a multiple of {ch} when using hist_kernel=pallas)")
-    assert k * w <= _LANES
+    if k * w > _LANES:
+        # a ValueError, not an assert: asserts vanish under `python -O`
+        # and this is a caller-reachable configuration error (the grower
+        # only routes w * k <= 128 waves here, but direct callers can
+        # pass anything)
+        raise ValueError(
+            f"pallas wave-histogram needs stat columns x wave width "
+            f"({k} x {w} = {k * w}) to fit one {_LANES}-lane tile; "
+            f"use a narrower wave or the einsum path "
+            f"(hist_kernel=einsum) for multi-tile waves")
     grid = (n // ch,)
     leaf2 = leaf_id.reshape(n, 1)
     pend2 = pending.reshape(1, w)

@@ -85,7 +85,9 @@ PARAM_SCHEMA: Sequence[Param] = (
     _p("config", str, "", ("config_file",),
        desc="path to a key=value config file (CLI)", section="core"),
     _p("task", str, "train", ("task_type",),
-       desc="train, predict (prediction), convert_model, refit (refit_tree)",
+       desc="train, predict (prediction), convert_model, refit "
+            "(refit_tree), warmup (AOT compile warmup into the "
+            "persistent cache, docs/ColdStart.md)",
        section="core"),
     _p("objective", str, "regression",
        ("objective_type", "app", "application"),
@@ -448,6 +450,67 @@ PARAM_SCHEMA: Sequence[Param] = (
             "dispatch), above it the single fused device dispatch wins "
             "on throughput. Tune per deployment; the PredictionServer "
             "(lightgbm_tpu.serve) always uses the device kernel",
+       section="device"),
+    _p("train_row_bucketing", bool, True, ("row_bucketing",),
+       desc="pad the training row count to a pow2 bucket (ops/histogram."
+            "bucket_size, min 1024 — the same ladder the bagging buffer "
+            "and the serving path already use) before the device "
+            "grower's program-cache signature, so ONE compiled program "
+            "family covers a whole traffic range of retrain-window sizes "
+            "instead of one program per exact row count (the real row "
+            "count travels as a traced scalar; padded rows carry zero "
+            "gradient/hessian/count, exactly like the chunk pad). Trees "
+            "are byte-identical to the unbucketed path. Auto-disabled "
+            "with grad_quant_bits=8 (the stochastic rounding stream is "
+            "keyed on the padded shape), for objectives whose fused "
+            "device gradient is not row-local (lambdarank), and when "
+            "the pow2 bucket would cross the striped-count bound "
+            "(datasets over 2^24 rows fall back to exact rows, logged). "
+            "See docs/ColdStart.md", section="device"),
+    _p("compile_cache_dir", str, "", ("xla_cache_dir",),
+       desc="directory for JAX's persistent XLA compilation cache "
+            "(lightgbm_tpu.compile_cache): compiled executables are "
+            "written to an on-disk LRU store so a FRESH process training "
+            "the same (bucketed shape, config) pays zero XLA recompiles "
+            "— the cross-process completion of the in-process "
+            "grower_cache. Empty = use the LGBM_TPU_COMPILE_CACHE env "
+            "var if set, else no persistent cache. Precompile a "
+            "deployment's declared shapes with the warmup entry points "
+            "(task=warmup / LGBM_WarmupTrain). See docs/ColdStart.md",
+       section="device"),
+    _p("compile_cache_min_entry_bytes", int, 0, (),
+       check=">= 0",
+       desc="skip persisting compiled executables smaller than this "
+            "many bytes (0 = persist everything, the default: the "
+            "warm-cold-start contract and the CI zero-miss smoke need "
+            "even sub-second glue ops cached). Raise it when a "
+            "deployment wants a lean cache dir at the cost of a few "
+            "small recompiles", section="device"),
+    _p("compile_cache_strict_keys", bool, False, (),
+       desc="sharing-safety knob for a compile cache dir mounted across "
+            "heterogeneous hosts: include compiler/runtime build "
+            "metadata in the cache key, so an executable compiled by a "
+            "different jaxlib/XLA build is never reused (a guaranteed "
+            "miss instead of trusting serialized-executable "
+            "compatibility). Leave off for identical builds — strict "
+            "keys make every software update a full cold start",
+       section="device"),
+    _p("warmup_rows", list, [], (),
+       desc="task=warmup (CLI) / lightgbm_tpu.warmup: comma-separated "
+            "training row counts to precompile grower programs for "
+            "(each is padded to its pow2 bucket under "
+            "train_row_bucketing, so one entry covers the whole "
+            "bucket's window-size range)", section="device"),
+    _p("warmup_features", int, 0, (),
+       check=">= 0",
+       desc="task=warmup: feature count of the declared training/"
+            "serving shape (ignored when a data= file is given — the "
+            "file's binned shape is used instead)", section="device"),
+    _p("warmup_serve_rows", list, [], (),
+       desc="task=warmup: serving batch-row buckets to precompile the "
+            "packed-forest traversal for; unset = skip the serving "
+            "warmup; a 0 entry = the PredictionServer warmup defaults "
+            "(128/1024/8192 plus the device_predict_min_rows bucket)",
        section="device"),
     _p("fused_chunk", int, 20, (),
        check=">= 0",

@@ -41,7 +41,27 @@ from .. import obs
 from ..utils.log import LightGBMError
 from .packed import PackedEnsemble, pack_gbdt, predict_scores, row_bucket
 
-__all__ = ["PredictionServer"]
+__all__ = ["PredictionServer", "warmup_bucket_ladder"]
+
+
+def warmup_bucket_ladder(min_rows: Optional[int] = None,
+                         min_bucket: int = 128) -> List[int]:
+    """The ONE definition of the default warmup bucket set: the
+    small-batch ladder plus the ``device_predict_min_rows`` bucket —
+    the batch size at which ``GBDT.predict_raw`` auto-routing switches
+    to the device kernel, so the first large batch is never a cold
+    compile.  Shared by :meth:`PredictionServer.default_warmup_buckets`
+    and the AOT serving warmup (``lightgbm_tpu.warmup.warmup_serve``);
+    ``None`` means the schema default."""
+    if min_rows is None:
+        from ..params import PARAM_BY_NAME
+        min_rows = int(PARAM_BY_NAME["device_predict_min_rows"].default)
+    out = [128, 1024, 8192]
+    if min_rows > 0:
+        b = row_bucket(int(min_rows), min_bucket)
+        if b not in out:
+            out.append(b)
+    return out
 
 
 def _as_gbdt(booster):
@@ -95,7 +115,13 @@ class PredictionServer:
 
     def __init__(self, booster=None, *, num_iteration: int = -1,
                  start_iteration: int = 0, max_batch: int = 8192,
-                 max_wait_ms: float = 2.0, min_bucket: int = 128):
+                 max_wait_ms: float = 2.0, min_bucket: int = 128,
+                 device_predict_min_rows: Optional[int] = None):
+        # serving restarts cold too: pick up the persistent compile
+        # cache from the environment so the packed traversal programs
+        # load from disk (docs/ColdStart.md)
+        from .. import compile_cache
+        compile_cache.configure_from_env()
         self._lock = threading.Lock()
         self._model: Optional[_Model] = None
         self.num_iteration = int(num_iteration)
@@ -103,6 +129,14 @@ class PredictionServer:
         self.max_batch = int(max_batch)
         self.max_wait_ms = float(max_wait_ms)
         self.min_bucket = int(min_bucket)
+        # warmup() default buckets derive from this (None = adopt the
+        # swapped booster's config, else the schema default): the bucket
+        # the GBDT.predict_raw auto-routing switches to the device
+        # kernel at MUST be warm, or the first large batch pays the
+        # cold compile the small-bucket warmups were meant to prevent
+        self.device_predict_min_rows = (
+            None if device_predict_min_rows is None
+            else int(device_predict_min_rows))
         self._queue: Queue = Queue()
         self._worker: Optional[threading.Thread] = None
         self._stopping = threading.Event()
@@ -117,6 +151,11 @@ class PredictionServer:
         signature matches the previous one — the zero-retrace case the
         window loop relies on."""
         gbdt = _as_gbdt(booster)
+        if self.device_predict_min_rows is None:
+            cfg_rows = getattr(getattr(gbdt, "config", None),
+                               "device_predict_min_rows", None)
+            if cfg_rows is not None:
+                self.device_predict_min_rows = int(cfg_rows)
         with obs.span("serve.swap", cat="serve"):
             packed = pack_gbdt(gbdt, self.start_iteration,
                                self.num_iteration)
@@ -144,11 +183,22 @@ class PredictionServer:
     def packed(self) -> PackedEnsemble:
         return self._snapshot().packed
 
-    def warmup(self, row_buckets: Sequence[int] = (128, 1024, 8192)
+    def default_warmup_buckets(self) -> List[int]:
+        """The bucket ladder ``warmup()`` precompiles by default
+        (:func:`warmup_bucket_ladder` with this server's configured
+        ``device_predict_min_rows``)."""
+        return warmup_bucket_ladder(self.device_predict_min_rows,
+                                    self.min_bucket)
+
+    def warmup(self, row_buckets: Optional[Sequence[int]] = None
                ) -> List[int]:
         """Precompile the traversal program for each pow2 row bucket;
         returns the bucket list actually compiled.  Idempotent: warm
-        buckets hit the jit cache."""
+        buckets hit the jit cache.  ``None`` uses
+        :meth:`default_warmup_buckets` (which includes the
+        ``device_predict_min_rows`` bucket)."""
+        if row_buckets is None:
+            row_buckets = self.default_warmup_buckets()
         model = self._snapshot()
         nf = model.packed.num_features
         done = []

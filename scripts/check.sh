@@ -56,6 +56,13 @@ step "docs freshness" python scripts/check_docs_params.py
 
 # 5. tier-1 tests (ROADMAP.md command)
 if [[ "${1:-}" != "--fast" ]]; then
+    # 5a. cold-start smoke: AOT warmup into a temp cache dir, then a
+    #     fresh subprocess training run must report ZERO persistent-
+    #     compile-cache misses for the warmed declaration
+    #     (docs/ColdStart.md).  Spawns two XLA-compiling subprocesses,
+    #     so it lives with the test runs, not the lint-speed --fast set
+    step "coldstart smoke" python scripts/check_coldstart.py
+
     tier1() {
         rm -f /tmp/_t1.log
         timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ \

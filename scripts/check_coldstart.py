@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""CI cold-start smoke: AOT warmup => a fresh training process is warm.
+
+Fast contract check for the persistent-compile-cache story
+(docs/ColdStart.md), run by scripts/check.sh:
+
+1. spawn the ``lightgbm-tpu warmup`` CLI into a temp cache dir with a
+   small declared (rows, features, config) shape;
+2. spawn a FRESH subprocess that runs a real training of the SAME
+   declaration (same synthetic generator, full iteration count — the
+   warmup itself only runs one fused chunk + remainder);
+3. assert the training process reports ZERO persistent-cache misses
+   (every executable it dispatched was pre-compiled by the warmup) and
+   a nonzero hit count.
+
+A nonzero miss count means some program the production path dispatches
+is not covered by the warmup's schedule — exactly the regression this
+smoke exists to catch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+ROWS = 3000
+FEATURES = 8
+DECLARATION = [
+    "objective=binary", "num_leaves=15", "num_iterations=4",
+    "fused_chunk=2", "device_growth=on", "max_bin=63", "verbosity=-1",
+    "bagging_fraction=0.8", "bagging_freq=2", "feature_fraction=0.9",
+]
+
+
+def probe() -> int:
+    """Fresh-process training run of the declared shape; prints the
+    compile-cache counters as one JSON line."""
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    import jax
+
+    from lightgbm_tpu import compile_cache
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.utils.log import set_verbosity
+    from lightgbm_tpu.warmup import _synth_dataset
+
+    set_verbosity(-1)
+    cfg = Config(dict(kv.split("=", 1) for kv in DECLARATION))
+    compile_cache.configure_from_config(cfg)
+    ds = _synth_dataset(ROWS, FEATURES, cfg)
+    bst = create_boosting(cfg)
+    bst.init_train(ds)
+    bst.train_chunked(cfg.num_iterations, chunk=cfg.fused_chunk)
+    jax.block_until_ready(bst.train_score)
+    bst._flush_pending()
+    print(json.dumps(compile_cache.counters()))
+    return 0
+
+
+def main() -> int:
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(here)
+    with tempfile.TemporaryDirectory(prefix="lgbm_coldstart_ci_") as tmp:
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "LGBM_TPU_CHUNK": env.get("LGBM_TPU_CHUNK", "8192"),
+            "LGBM_TPU_COMPILE_CACHE": tmp,
+        })
+        warm_cmd = ([sys.executable, "-m", "lightgbm_tpu", "warmup",
+                     f"warmup_rows={ROWS}", f"warmup_features={FEATURES}"]
+                    + DECLARATION)
+        r = subprocess.run(warm_cmd, env=env, cwd=repo,
+                           capture_output=True, text=True)
+        if r.returncode != 0:
+            print(f"FAIL warmup CLI rc={r.returncode}:\n"
+                  f"{r.stderr[-2000:]}")
+            return 1
+        entries = len([f for f in os.listdir(tmp)
+                       if f.endswith("-cache")])
+        r = subprocess.run([sys.executable, os.path.abspath(__file__),
+                            "--probe"], env=env, cwd=repo,
+                           capture_output=True, text=True)
+        if r.returncode != 0:
+            print(f"FAIL training probe rc={r.returncode}:\n"
+                  f"{r.stderr[-2000:]}")
+            return 1
+        counters = json.loads(r.stdout.strip().splitlines()[-1])
+    print(f"coldstart smoke: warmup wrote {entries} cache entries; "
+          f"fresh training run: {counters['hits']} hits, "
+          f"{counters['misses']} misses")
+    if counters["misses"] != 0:
+        print("FAIL: the warmed cache did not cover the training run "
+              "(a program the production path dispatches is missing "
+              "from the warmup schedule)")
+        return 1
+    if counters["hits"] <= 0:
+        print("FAIL: the training run never consulted the persistent "
+              "cache (is it disabled?)")
+        return 1
+    print("coldstart smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(probe() if "--probe" in sys.argv else main())

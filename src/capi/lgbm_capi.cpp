@@ -417,3 +417,39 @@ extern "C" int LGBM_ServeFree(ServeHandle handle) {
                                  static_cast<long long>(as_id(handle)));
   return none_result(call_adapter("serve_free", args));
 }
+
+/* ------------------------------------------------------------------ */
+/* AOT compile warmup (lightgbm_tpu extension)                         */
+/* ------------------------------------------------------------------ */
+
+int LGBM_WarmupTrain(
+    std::unordered_map<std::string, std::string> parameters,
+    int64_t num_row, int32_t num_feature, int* out_num_compiled) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(sLi)", params_string(parameters).c_str(),
+      static_cast<long long>(num_row), static_cast<int>(num_feature));
+  int64_t n = 0;
+  int rc = int_result(call_adapter("warmup_train", args), &n);
+  if (rc == 0 && out_num_compiled != nullptr) {
+    *out_num_compiled = static_cast<int>(n);
+  }
+  return rc;
+}
+
+int LGBM_WarmupServe(
+    std::unordered_map<std::string, std::string> parameters,
+    int64_t num_row, int32_t num_feature, int* out_num_compiled) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(sLi)", params_string(parameters).c_str(),
+      static_cast<long long>(num_row), static_cast<int>(num_feature));
+  int64_t n = 0;
+  int rc = int_result(call_adapter("warmup_serve", args), &n);
+  if (rc == 0 && out_num_compiled != nullptr) {
+    *out_num_compiled = static_cast<int>(n);
+  }
+  return rc;
+}

@@ -33,11 +33,13 @@ assert jax.default_backend() == "cpu", "tests must run on the CPU mesh"
 assert len(jax.devices()) == 8, "expected 8 virtual CPU devices"
 
 # persistent compilation cache: the padded-bucket shapes recur across tests,
-# so reruns skip nearly all XLA compiles
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.expanduser("~/.cache/lgbm_tpu_xla"))
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+# so reruns skip nearly all XLA compiles (routed through the library's
+# own activation path so tests exercise what production uses; tests that
+# need their OWN cache dir re-call compile_cache.configure)
+from lightgbm_tpu import compile_cache  # noqa: E402
+
+compile_cache.configure(os.environ.get(
+    compile_cache.ENV_VAR, os.path.expanduser("~/.cache/lgbm_tpu_xla")))
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
