@@ -652,7 +652,15 @@ def run_cache_admission(args) -> dict:
     """The fork's windowed cache-admission harness
     (examples/cache_admission.py) through the C API's chunked update —
     the workload this fork of LightGBM exists for.  Emits train seconds
-    per 1M sampled rows vs the reference's 125.4 s/20M-request window."""
+    per 1M sampled rows vs the reference's 125.4 s/20M-request window.
+
+    ``--pipeline`` runs the harness twice — the serial C-API loop, then
+    the async retrain pipeline (lightgbm_tpu.pipeline) over the same
+    trace — and reports the prep-overlap fraction plus the
+    pipelined-vs-serial end-to-end speedup next to the headline metric
+    (docs/Pipeline.md).  Serial runs first, so its compiled programs
+    warm the in-process caches for the pipelined leg and the speedup
+    isolates the pipelining itself, not compile time."""
     import importlib.util
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "examples", "cache_admission.py")
@@ -663,7 +671,21 @@ def run_cache_admission(args) -> dict:
     if args.quick:
         argv = ["--requests", "400000", "--objects", "50000",
                 "--window", "200000", "--sample", "100000"]
-    return mod.run(mod.build_arg_parser().parse_args(argv))
+    result = mod.run(mod.build_arg_parser().parse_args(argv))
+    if getattr(args, "pipeline", False):
+        pipe = mod.run(mod.build_arg_parser().parse_args(
+            argv + ["--pipeline"]))
+        result["pipeline"] = {
+            "value": pipe["value"],
+            "total_s": pipe["total_s"],
+            "overlap_fraction": pipe["overlap_fraction"],
+            "rebinned_windows": pipe["rebinned_windows"],
+            "windows": pipe["windows"],
+        }
+        result["pipeline_overlap_fraction"] = pipe["overlap_fraction"]
+        result["pipeline_speedup_e2e"] = round(
+            result["total_s"] / max(pipe["total_s"], 1e-9), 4)
+    return result
 
 
 def main() -> int:
@@ -745,6 +767,12 @@ def main() -> int:
                          "~/.cache/lgbm_tpu_xla")
     ap.add_argument("--cache-admission", action="store_true",
                     help="alias for --suite cache")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="--suite cache: also run the harness through "
+                         "the async windowed-retrain pipeline "
+                         "(lightgbm_tpu.pipeline) and report prep-"
+                         "overlap fraction + pipelined-vs-serial end-"
+                         "to-end speedup next to the headline metric")
     ap.add_argument("--metrics", default=os.environ.get("BENCH_METRICS",
                                                         ""),
                     help="write the telemetry metrics JSON snapshot "

@@ -26,6 +26,15 @@ No real CDN trace is on disk, so --trace synth generates a Zipf-popular
 object stream (ids ~ Zipf(0.8), lognormal sizes), the standard shape of
 the traces the fork was built for.  A file in the fork's whitespace
 format (``seq id size cost`` per line) is accepted too.
+
+Two execution modes share the summary schema: the default SERIAL loop
+(the reference's phase order through the C API, with window 0's bin
+mappers reused as the ``reference=`` for every later window), and
+``--pipeline``, which runs the same workload as a thin client of
+``lightgbm_tpu.pipeline.RetrainPipeline`` — host prep of window N+1
+overlapped against window N's training, drift-gated rebinding,
+``--window-policy`` warm starts, and serving that never goes down
+(docs/Pipeline.md).
 """
 
 from __future__ import annotations
@@ -199,18 +208,28 @@ class CApiTrainer:
         self.C = C
         self.booster = None
         self.server = None
+        # window 0's dataset handle survives as the bin-mapper
+        # reference: later windows construct AGAINST it (CreateValid
+        # semantics) instead of re-running find-bin, so feature groups
+        # — and therefore device program signatures — stay frozen
+        # across the whole run (docs/Pipeline.md)
+        self.ref_ds = None
 
     def _check(self, rc):
         if rc != 0:
             raise RuntimeError(self.C.LGBM_GetLastError())
 
-    def train_window(self, labels, indptr, indices, data):
+    def train_window(self, labels, indptr, indices, data) -> bool:
+        """Train one window; returns True when this window ran
+        find-bin (only the first window does — every later one reuses
+        the cached reference mappers)."""
         C = self.C
         ds = C.Ref()
+        rebinned = self.ref_ds is None
         self._check(C.LGBM_DatasetCreateFromCSR(
             indptr, C.C_API_DTYPE_INT32, indices, data,
             C.C_API_DTYPE_FLOAT64, len(indptr), len(data),
-            HISTFEATURES + 3, TRAIN_PARAMS, None, ds))
+            HISTFEATURES + 3, TRAIN_PARAMS, self.ref_ds, ds))
         self._check(C.LGBM_DatasetSetField(
             ds.value, "label", labels, len(labels), C.C_API_DTYPE_FLOAT32))
         bst = C.Ref()
@@ -232,7 +251,11 @@ class CApiTrainer:
         if self.booster is not None:
             self._check(C.LGBM_BoosterFree(self.booster))
         self.booster = bst.value
-        self._check(C.LGBM_DatasetFree(ds.value))
+        if rebinned:
+            self.ref_ds = ds.value    # keep alive: the mapper source
+        else:
+            self._check(C.LGBM_DatasetFree(ds.value))
+        return rebinned
 
     def evaluate(self, labels, indptr, indices, data, cutoff):
         C = self.C
@@ -276,28 +299,32 @@ def build_arg_parser() -> argparse.ArgumentParser:
                          "compiled programs from disk instead of "
                          "recompiling (docs/ColdStart.md); '' disables "
                          "unless LGBM_TPU_COMPILE_CACHE is set")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="run the windowed loop through the async "
+                         "retrain pipeline (lightgbm_tpu.pipeline, "
+                         "docs/Pipeline.md): window N+1's host prep "
+                         "(OPT labels, gap features, CSR binning) "
+                         "overlaps window N's device training while "
+                         "serving hot-swaps, instead of the serial "
+                         "C-API loop")
+    ap.add_argument("--window-policy", default="fresh",
+                    choices=("fresh", "refit", "warm"),
+                    help="--pipeline: how each window's model starts "
+                         "(fresh booster / leaf refit with decay / "
+                         "refit + continued boosting)")
+    ap.add_argument("--drift-threshold", type=float, default=0.1,
+                    help="--pipeline: re-run find-bin when the noise-"
+                         "adjusted bin-occupancy drift exceeds this")
+    ap.add_argument("--no-rebin", action="store_true",
+                    help="--pipeline: never re-run find-bin (freeze "
+                         "window 0's mappers for the whole run)")
     return ap
 
 
-def run(args) -> dict:
-    """Run the windowed harness; returns the summary dict (the JSON
-    line ``main`` prints).  Importable — ``bench.py --suite cache``
-    drives this directly."""
-    from lightgbm_tpu import compile_cache, obs
-    if args.metrics or args.obs_trace:
-        obs.configure(enabled=True, metrics_path=args.metrics or None,
-                      trace_path=args.obs_trace or None)
-    compile_cache.configure(getattr(args, "compile_cache", ""))
-
-    if args.trace == "synth":
-        ids, sizes, costs = synth_trace(args.requests, args.objects)
-    else:
-        raw = np.loadtxt(args.trace)
-        ids = raw[:, 1].astype(np.int64)
-        sizes = raw[:, 2].astype(np.int64)
-        costs = raw[:, 3].astype(np.float64)
-
-    rng = np.random.default_rng(13)
+def _run_serial(args, ids, sizes, costs, rng) -> list:
+    """The reference's serial loop (label -> eval -> derive -> train)
+    through the C API; returns the per-window record list."""
+    from lightgbm_tpu import obs
     trainer = CApiTrainer()
     windows = []
     n_windows = len(ids) // args.window
@@ -327,18 +354,143 @@ def run(args) -> dict:
         t_derive = time.perf_counter() - t0
 
         t0 = time.perf_counter()
-        trainer.train_window(*feats)
+        rebinned = trainer.train_window(*feats)
         t_train = time.perf_counter() - t0
 
         windows.append({
             "window": w, "opt_admit_ratio": round(opt_ratio, 4),
-            "rows_trained": int(len(feats[0])),
+            "rows_trained": int(len(feats[0])), "rebinned": rebinned,
             "opt_s": round(t_opt, 2), "derive_s": round(t_derive, 2),
             "train_s": round(t_train, 2), "eval_s": round(t_eval, 2),
             "fp": round(fp, 4) if fp is not None else None,
             "fn": round(fn, 4) if fn is not None else None,
         })
         print(json.dumps(windows[-1]), file=sys.stderr, flush=True)
+    return windows
+
+
+def _csr_row_subset(indptr, indices, data, keep):
+    """CSR rows selected by boolean mask ``keep`` (one gather)."""
+    rows = np.flatnonzero(keep)
+    counts = (indptr[rows + 1] - indptr[rows]).astype(np.int64)
+    out_indptr = np.zeros(len(rows) + 1, np.int32)
+    out_indptr[1:] = np.cumsum(counts)
+    flat = np.repeat(indptr[rows].astype(np.int64)
+                     - out_indptr[:-1], counts) \
+        + np.arange(int(out_indptr[-1]), dtype=np.int64)
+    return out_indptr, indices[flat], data[flat]
+
+
+def _run_pipelined(args, ids, sizes, costs, rng):
+    """The same windowed workload as a thin client of
+    ``lightgbm_tpu.pipeline.RetrainPipeline``: OPT labeling + feature
+    derivation + CSR binning run on the pipeline's prep thread
+    (overlapped with the previous window's training), models hot-swap
+    into its PredictionServer, and the previous model is scored on each
+    window's full request stream before retraining.
+
+    Prep derives each window's features ONCE: the serial loop — faithful
+    to test.cpp — runs deriveFeatures twice per window (all rows for
+    evaluateModel, sampled rows for trainModel), but the training rows
+    are exactly a row subset of the full-window CSR (gap features and
+    the admission state walk are computed over the whole window either
+    way), so the pipeline carves them out with one gather instead of a
+    second derivation pass.  Returns ``(windows, pipe)``."""
+    from lightgbm_tpu.pipeline import PreppedWindow, RetrainPipeline
+
+    n_windows = len(ids) // args.window
+    ncol = HISTFEATURES + 3
+
+    def prep(w):
+        lo, hi = w * args.window, (w + 1) * args.window
+        wid, wsz, wco = ids[lo:hi], sizes[lo:hi], costs[lo:hi]
+        t0 = time.perf_counter()
+        to_cache, opt_ratio = calculate_opt(wid, wsz, args.cache_size,
+                                            args.window)
+        t_opt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        if w > 0:
+            # one full-window derivation serves eval AND training
+            ev = derive_features(wid, wsz, wco, to_cache,
+                                 args.cache_size, args.window, 0, rng)
+            n = len(ev[0])
+            if args.sampling == 1:
+                keep = np.arange(n) >= (n - args.sample)
+            elif args.sampling == 2:
+                keep = rng.random(n) < args.sample / n
+            else:
+                keep = np.ones(n, bool)
+            indptr, indices, data = _csr_row_subset(ev[1], ev[2],
+                                                    ev[3], keep)
+            labels = ev[0][keep]
+            eval_label, eval_csr = ev[0], (ev[1], ev[2], ev[3], ncol)
+        else:
+            # window 0 is never evaluated: derive the sampled rows only
+            labels, indptr, indices, data = derive_features(
+                wid, wsz, wco, to_cache, args.cache_size, args.sample,
+                args.sampling, rng)
+            eval_label = eval_csr = None
+        t_derive = time.perf_counter() - t0
+        return PreppedWindow(
+            label=labels, csr=(indptr, indices, data, ncol),
+            eval_label=eval_label, eval_csr=eval_csr,
+            meta={"opt_admit_ratio": round(opt_ratio, 4),
+                  "opt_s": round(t_opt, 2),
+                  "derive_s": round(t_derive, 2)})
+
+    def eval_fn(pred, pw):
+        labels = pw.eval_label
+        fp = float(((labels < args.cutoff)
+                    & (pred >= args.cutoff)).sum()) / len(labels)
+        fn = float(((labels >= args.cutoff)
+                    & (pred < args.cutoff)).sum()) / len(labels)
+        return {"fp": round(fp, 4), "fn": round(fn, 4)}
+
+    pipe = RetrainPipeline(
+        TRAIN_PARAMS, num_iterations=NUM_ITERATIONS, chunk=TRAIN_CHUNK,
+        window_policy=args.window_policy,
+        rebin_on_drift=not args.no_rebin,
+        drift_threshold=args.drift_threshold,
+        keep_boosters=False)
+    windows = []
+
+    def on_window(res):
+        windows.append(res.to_json())
+        print(json.dumps(windows[-1]), file=sys.stderr, flush=True)
+
+    pipe.run(range(n_windows), prep, eval_fn=eval_fn,
+             on_window=on_window)
+    return windows, pipe
+
+
+def run(args) -> dict:
+    """Run the windowed harness; returns the summary dict (the JSON
+    line ``main`` prints).  Importable — ``bench.py --suite cache``
+    drives this directly."""
+    from lightgbm_tpu import compile_cache, obs
+    if args.metrics or args.obs_trace:
+        obs.configure(enabled=True, metrics_path=args.metrics or None,
+                      trace_path=args.obs_trace or None)
+    compile_cache.configure(getattr(args, "compile_cache", ""))
+
+    if args.trace == "synth":
+        ids, sizes, costs = synth_trace(args.requests, args.objects)
+    else:
+        raw = np.loadtxt(args.trace)
+        ids = raw[:, 1].astype(np.int64)
+        sizes = raw[:, 2].astype(np.int64)
+        costs = raw[:, 3].astype(np.float64)
+
+    rng = np.random.default_rng(13)
+    pipelined = bool(getattr(args, "pipeline", False))
+    t_start = time.perf_counter()
+    overlap = None
+    if pipelined:
+        windows, pipe = _run_pipelined(args, ids, sizes, costs, rng)
+        overlap = pipe.overlap_fraction
+    else:
+        windows = _run_serial(args, ids, sizes, costs, rng)
+    total_s = time.perf_counter() - t_start
 
     # reference per-window wall-clock at 20M requests -> normalize per 1M
     steady = windows[1:] or windows
@@ -360,6 +512,11 @@ def run(args) -> dict:
         "derive_s_per_1M_requests": round(derive_per_m, 3),
         "ref_derive_s_per_1M": round(94.6 / 20.0, 3),
         "train_chunk": TRAIN_CHUNK,
+        "pipeline": pipelined,
+        "total_s": round(total_s, 2),
+        "overlap_fraction": (None if overlap is None
+                             else round(overlap, 4)),
+        "rebinned_windows": sum(1 for w in windows if w.get("rebinned")),
         "windows": windows,
         "obs": obs_summary,
     }

@@ -419,44 +419,15 @@ class Booster:
 
     def refit(self, data, label, decay_rate=0.9, **kwargs):
         """Refit leaf values on new data (reference RefitTree,
-        gbdt.cpp:265-288)."""
+        gbdt.cpp:265-288): returns a NEW Booster sharing this model's
+        tree structure with leaf values re-fit against ``label`` with
+        ``decay_rate`` (``GBDT.refit_leaves`` holds the vectorized
+        core — the windowed-retrain pipeline's ``refit``/``warm``
+        policies drive the same code from binned leaf assignments)."""
         arr, _ = _to_2d_float(data)
-        label = np.asarray(label, np.float64)
         new_booster = Booster(model_str=self.model_to_string(),
                               params=self.params)
-        cfg = Config(self.params)
-        gbdt = new_booster._gbdt
-        # gradients at the model's raw predictions
-        from .objectives import create_objective
-        obj_str = gbdt.loaded_objective_str or "regression"
-        cfg2 = Config({**self.params,
-                       "objective": obj_str.split()[0],
-                       "num_class": max(gbdt.num_model, 1)})
-        obj = create_objective(cfg2)
-        md = Metadata(len(label))
-        md.set_label(label)
-        obj.init(md, len(label))
-        raw = gbdt.predict_raw(arr)
-        import jax.numpy as jnp
-        grad, hess = obj.get_gradients(jnp.asarray(raw, jnp.float32))
-        grad = np.asarray(grad).reshape(gbdt.num_model, -1)
-        hess = np.asarray(hess).reshape(gbdt.num_model, -1)
-        for it in range(gbdt.num_iterations()):
-            for k in range(gbdt.num_model):
-                tree = gbdt.models[it * gbdt.num_model + k]
-                leaves = tree.predict_leaf(arr)
-                for leaf in range(tree.num_leaves):
-                    rows = leaves == leaf
-                    if not rows.any():
-                        continue
-                    sg = float(grad[k][rows].sum())
-                    sh = float(hess[k][rows].sum())
-                    nv = -sg / (sh + cfg.lambda_l2) if sh + cfg.lambda_l2 \
-                        else 0.0
-                    old = float(tree.leaf_value[leaf])
-                    tree.set_leaf_output(
-                        leaf, decay_rate * old + (1.0 - decay_rate)
-                        * nv * cfg.learning_rate)
+        new_booster._gbdt.refit_leaves(arr, label, decay_rate=decay_rate)
         return new_booster
 
     # ------------------------------------------------------------------

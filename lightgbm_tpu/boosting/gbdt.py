@@ -1047,6 +1047,92 @@ class GBDT:
         return out.reshape(n, -1)
 
     # ------------------------------------------------------------------
+    # leaf refit on new data (reference GBDT::RefitTree, gbdt.cpp:265-288)
+    def _refit_objective(self):
+        """A FRESH objective bound to nothing, for refit gradients — the
+        live training objective (if any) must keep its original labels,
+        so refit never reuses it.  Loaded models reconstruct from the
+        model string's objective line including its ``key:value`` extras
+        (``binary sigmoid:2`` keeps sigmoid=2, which the old refit path
+        dropped)."""
+        if self.objective is not None:
+            name = self.objective.name
+            extras = {}
+        elif self.loaded_objective_str:
+            toks = self.loaded_objective_str.split()
+            name = toks[0]
+            extras = dict(t.split(":", 1) for t in toks[1:] if ":" in t)
+        else:
+            name = "regression"
+            extras = {}
+        if name in ("none", ""):
+            raise LightGBMError(
+                "refit requires an objective; this model was trained "
+                "with a custom objective function")
+        keys = ("sigmoid", "alpha", "fair_c", "poisson_max_delta_step",
+                "tweedie_variance_power", "scale_pos_weight",
+                "is_unbalance", "reg_sqrt", "num_class", "max_position",
+                "label_gain")
+        params = {k: getattr(self.config, k) for k in keys}
+        params.update(extras)
+        params["objective"] = name
+        params["num_class"] = max(self.num_model, 1)
+        return create_objective(Config(params))
+
+    def refit_leaves(self, data, label, decay_rate: float = 0.9,
+                     leaf_ids=None) -> "GBDT":
+        """Refit every tree's leaf values IN PLACE against ``label`` on
+        new data, keeping the routing structure: for each leaf that
+        received rows, ``new = decay * old + (1 - decay) * optimal *
+        learning_rate`` where ``optimal`` is the L1/L2-regularized leaf
+        output from the new data's gradients (the reference's
+        RefitTree / CalculateSplittedLeafOutput).  Leaves that received
+        no rows keep their old value.
+
+        ``data`` is a dense raw-feature matrix; ``leaf_ids`` (optional)
+        is a precomputed per-tree leaf-assignment list — the windowed
+        pipeline passes assignments from the on-device binned traversal
+        so refit never walks host trees row by row.  Callers wanting a
+        copy clone first (``Booster.refit`` does).
+        """
+        self._flush_pending()
+        label = np.asarray(label, np.float64)
+        from ..data.dataset import Metadata
+        obj = self._refit_objective()
+        md = Metadata(len(label))
+        md.set_label(label)
+        obj.init(md, len(label))
+        if leaf_ids is None:
+            arr = np.ascontiguousarray(np.asarray(data, np.float64))
+            raw = self.predict_raw(arr)
+            leaf_ids = [tree.predict_leaf(arr) if tree.num_leaves > 1
+                        else None for tree in self.models]
+        else:
+            # assignments given: raw scores rebuild from leaf values, so
+            # the (possibly binned-only) feature matrix is never touched
+            raw = np.zeros((self.num_model, len(label)), np.float64)
+            for idx, tree in enumerate(self.models):
+                k = idx % self.num_model
+                if leaf_ids[idx] is None:
+                    raw[k] += tree.leaf_value[0]    # host stump value
+                else:
+                    raw[k] += tree.leaf_value[leaf_ids[idx]]
+        grad, hess = obj.get_gradients(jnp.asarray(raw, jnp.float32))
+        if grad.ndim == 1:
+            grad, hess = grad[None, :], hess[None, :]
+        grad = np.asarray(grad, np.float64)
+        hess = np.asarray(hess, np.float64)
+        shrink = float(self.config.learning_rate)
+        for idx, tree in enumerate(self.models):
+            k = idx % self.num_model
+            refit_tree_leaves(tree, leaf_ids[idx], grad[k], hess[k],
+                              self.config, decay_rate, shrink)
+        # in-place leaf edits invalidate the packed-predict cache (its
+        # key only sees the model COUNT, not leaf values)
+        self._packed_cache = None
+        return self
+
+    # ------------------------------------------------------------------
     def feature_importance(self, importance_type="split",
                            iteration: int = -1) -> np.ndarray:
         self._flush_pending()
@@ -1168,6 +1254,50 @@ class GBDT:
     def load_model_from_file(cls, filename, config=None) -> "GBDT":
         with open(filename) as fh:
             return cls.load_model_from_string(fh.read(), config)
+
+
+def _refit_leaf_optimum(sum_grad: np.ndarray, sum_hess: np.ndarray,
+                        config) -> np.ndarray:
+    """Vectorized regularized leaf output (the reference's
+    ``FeatureHistogram::CalculateSplittedLeafOutput``):
+    ``-ThresholdL1(sum_grad, l1) / (sum_hess + l2)``, clipped to
+    ``+-max_delta_step`` when that is set."""
+    l1 = float(config.lambda_l1)
+    l2 = float(config.lambda_l2)
+    thr = np.sign(sum_grad) * np.maximum(np.abs(sum_grad) - l1, 0.0)
+    denom = sum_hess + l2
+    safe = denom > 0.0
+    out = np.where(safe, -thr / np.where(safe, denom, 1.0), 0.0)
+    mds = float(getattr(config, "max_delta_step", 0.0))
+    if mds > 0.0:
+        out = np.clip(out, -mds, mds)
+    return out
+
+
+def refit_tree_leaves(tree: Tree, leaf_ids, grad: np.ndarray,
+                      hess: np.ndarray, config, decay_rate: float,
+                      shrinkage: float) -> None:
+    """Refit one tree's leaf values in place from new-data gradients
+    (one ``np.bincount`` per statistic instead of the old
+    O(leaves x rows) masked-sum walk).  ``leaf_ids`` is the per-row leaf
+    assignment, or ``None`` for a stump (every row in leaf 0).  Empty
+    leaves keep their old value; routing arrays are untouched."""
+    n_leaves = max(int(tree.num_leaves), 1)
+    if leaf_ids is None:
+        cnt = np.array([len(grad)], np.int64)
+        sg = np.array([float(np.sum(grad))])
+        sh = np.array([float(np.sum(hess))])
+    else:
+        leaf_ids = np.asarray(leaf_ids)
+        cnt = np.bincount(leaf_ids, minlength=n_leaves)[:n_leaves]
+        sg = np.bincount(leaf_ids, weights=grad,
+                         minlength=n_leaves)[:n_leaves]
+        sh = np.bincount(leaf_ids, weights=hess,
+                         minlength=n_leaves)[:n_leaves]
+    optimal = _refit_leaf_optimum(sg, sh, config) * shrinkage
+    old = tree.leaf_value[:n_leaves]
+    tree.leaf_value[:n_leaves] = np.where(
+        cnt > 0, decay_rate * old + (1.0 - decay_rate) * optimal, old)
 
 
 def _convert_by_name(objective_str: str, raw: np.ndarray) -> np.ndarray:

@@ -1,4 +1,4 @@
-"""CLI application: train / predict / convert_model / refit.
+"""CLI application: train / predict / convert_model / refit / pipeline.
 
 Re-implements the reference ``Application`` lifecycle
 (``src/application/application.cpp``, ``include/LightGBM/application.h:91-103``)
@@ -226,6 +226,60 @@ def run_refit(cfg: Config):
     log_info("Finished refitting")
 
 
+def run_pipeline(cfg: Config):
+    """Windowed-retrain pipeline over the training file
+    (docs/Pipeline.md): the rows are replayed as ``pipeline_windows``
+    equal windows; each window is scored against the currently served
+    model (test-then-train), then retrained per ``window_policy`` with
+    host prep of the NEXT window overlapped against device training,
+    and hot-swapped into the serving ensemble.  The final window's
+    model is saved to ``output_model``."""
+    import json
+
+    from .pipeline import PreppedWindow, RetrainPipeline
+
+    arr, label, _ = load_text_file(cfg.data, cfg)
+    if label is None:
+        raise LightGBMError("task=pipeline requires labeled data")
+    nw = max(int(cfg.pipeline_windows), 1)
+    bounds = np.linspace(0, arr.shape[0], nw + 1).astype(np.int64)
+    payloads = [(int(bounds[i]), int(bounds[i + 1])) for i in range(nw)]
+    cats = _parse_categorical(cfg, arr.shape[1])
+    objective = str(cfg.objective)
+
+    def prep(payload):
+        lo, hi = payload
+        return PreppedWindow(label=label[lo:hi], dense=arr[lo:hi],
+                             eval_label=label[lo:hi],
+                             eval_dense=arr[lo:hi])
+
+    def eval_fn(pred, pw):
+        # test-then-train quality of the PREVIOUS model on this window
+        y = np.asarray(pw.eval_label, np.float64)
+        p = np.asarray(pred, np.float64)
+        if objective.startswith("binary"):
+            return {"prev_model_error":
+                    round(float(np.mean((p >= 0.5) != (y >= 0.5))), 5)}
+        if p.ndim > 1:   # multiclass: argmax error
+            return {"prev_model_error":
+                    round(float(np.mean(np.argmax(p, axis=1) != y)), 5)}
+        return {"prev_model_rmse":
+                round(float(np.sqrt(np.mean((p - y) ** 2))), 6)}
+
+    pipe = RetrainPipeline(cfg, categorical=cats, keep_boosters=False)
+    results = pipe.run(payloads, prep, eval_fn=eval_fn,
+                       on_window=lambda r: log_info(
+                           "pipeline window " + json.dumps(r.to_json())))
+    frac = pipe.overlap_fraction
+    if frac is not None:
+        log_info(f"pipeline prep overlap fraction: {frac:.3f}")
+    booster = pipe.final_booster()
+    if booster is not None:
+        booster.save_model_to_file(cfg.output_model
+                                   or "LightGBM_model.txt")
+    log_info(f"Finished pipeline ({len(results)} windows)")
+
+
 def run_warmup(cfg: Config):
     """Ahead-of-time compile warmup (docs/ColdStart.md): precompile the
     declared (rows, features, config) training + serving program
@@ -238,9 +292,9 @@ def run_warmup(cfg: Config):
 
 def main(argv=None):
     argv = list(argv if argv is not None else sys.argv[1:])
-    # `lightgbm-tpu warmup key=value...` subcommand sugar for task=warmup
-    if argv and argv[0] == "warmup":
-        argv = argv[1:] + ["task=warmup"]
+    # `lightgbm-tpu warmup|pipeline key=value...` subcommand sugar
+    if argv and argv[0] in ("warmup", "pipeline"):
+        argv = argv[1:] + [f"task={argv[0]}"]
     params = parse_cli_args(argv)
     if not params:
         print("usage: python -m lightgbm_tpu config=train.conf [key=value...]\n"
@@ -263,6 +317,8 @@ def main(argv=None):
         run_refit(cfg)
     elif task == "warmup":
         run_warmup(cfg)
+    elif task == "pipeline":
+        run_pipeline(cfg)
     else:
         raise LightGBMError(f"unknown task: {task}")
     return 0

@@ -318,6 +318,21 @@ def summary() -> Dict:
             "swaps": snap["counters"].get("serve.swaps", 0),
             "rows": snap["counters"].get("serve.rows", 0),
         }
+    windows = snap["counters"].get("pipeline.windows", 0)
+    if windows:
+        prep = snap["timings"].get("pipeline.prep")
+        train = snap["timings"].get("pipeline.train")
+        stall = snap["timings"].get("pipeline.stall")
+        out["pipeline"] = {
+            "windows": windows,
+            "rebinds": snap["counters"].get("pipeline.rebinds", 0),
+            "overlap_fraction": STATE.registry.gauge(
+                "pipeline.overlap_fraction"),
+            "prep_p50_s": round(prep["p50_s"], 3) if prep else None,
+            "train_p50_s": round(train["p50_s"], 3) if train else None,
+            "stall_total_s": round(stall["total_s"], 3) if stall
+            else 0.0,
+        }
     return out
 
 

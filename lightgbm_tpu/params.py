@@ -87,7 +87,8 @@ PARAM_SCHEMA: Sequence[Param] = (
     _p("task", str, "train", ("task_type",),
        desc="train, predict (prediction), convert_model, refit "
             "(refit_tree), warmup (AOT compile warmup into the "
-            "persistent cache, docs/ColdStart.md)",
+            "persistent cache, docs/ColdStart.md), pipeline (windowed-"
+            "retrain pipeline over the data file, docs/Pipeline.md)",
        section="core"),
     _p("objective", str, "regression",
        ("objective_type", "app", "application"),
@@ -212,7 +213,25 @@ PARAM_SCHEMA: Sequence[Param] = (
        ("fs", "forced_splits_filename", "forced_splits_file", "forced_splits"),
        desc="path to a JSON file of forced splits", section="learning"),
     _p("refit_decay_rate", float, 0.9, (), check="0.0 <= x <= 1.0",
-       desc="decay rate of leaf values in refit task", section="learning"),
+       desc="decay rate of leaf values in the refit task and in the "
+            "pipeline's refit/warm window policies: new leaf value = "
+            "decay * old + (1 - decay) * optimal-on-new-data",
+       section="learning"),
+    _p("window_policy", str, "fresh", (),
+       check="fresh/refit/warm",
+       desc="how each retrain window of the windowed pipeline "
+            "(lightgbm_tpu.pipeline, docs/Pipeline.md) starts: fresh = "
+            "train a new booster from scratch (the reference harness's "
+            "behaviour; byte-identical to the serial loop); refit = "
+            "keep the previous ensemble's routing structure and re-fit "
+            "leaf values against the new labels with refit_decay_rate "
+            "(no new trees); warm = refit, then continue boosting "
+            "pipeline_warm_iterations new trees on top (tree count "
+            "grows per window — pad-boundary crossings re-trace the "
+            "serving kernel)", section="learning"),
+    _p("pipeline_warm_iterations", int, 0, (), check=">= 0",
+       desc="extra boosting iterations per window under "
+            "window_policy=warm; 0 = num_iterations", section="learning"),
     _p("verbosity", int, 1, ("verbose",),
        desc="<0 fatal only, 0 error/warning, 1 info, >1 debug", section="io"),
 
@@ -316,6 +335,27 @@ PARAM_SCHEMA: Sequence[Param] = (
     _p("metrics_path", str, "", ("metrics_file",),
        desc="write the telemetry metrics JSON snapshot to this path at the "
             "end of train() (implies metrics_enabled)", section="io"),
+    _p("pipeline_windows", int, 4, (), check="> 0",
+       desc="task=pipeline (CLI): number of equal row windows the "
+            "training file is replayed as through the windowed-retrain "
+            "pipeline (docs/Pipeline.md); each window is scored against "
+            "the previously served model (test-then-train), then "
+            "retrained per window_policy and hot-swapped into serving",
+       section="io"),
+    _p("pipeline_rebin", bool, True, (),
+       desc="windowed pipeline: allow drift-triggered re-find-bin. "
+            "When false, every window is constructed against the first "
+            "window's bin mappers unconditionally — program signatures "
+            "stay frozen (zero retraces) and, with window_policy=fresh, "
+            "the pipelined loop is byte-identical to the serial one",
+       section="io"),
+    _p("pipeline_drift_threshold", float, 0.1, (), check=">= 0.0",
+       desc="windowed pipeline: re-run find-bin when a window's "
+            "noise-adjusted bin-occupancy drift (mean per-group total-"
+            "variation distance vs the cached mappers' occupancy, minus "
+            "the expected sampling noise — docs/Pipeline.md) exceeds "
+            "this; a rebind changes program signatures, so expect a "
+            "one-off retrace on that window", section="io"),
     _p("trace_path", str, "", ("trace_file",),
        desc="write a Chrome-trace / Perfetto timeline of the run to this "
             "path at the end of train() (implies metrics_enabled). Open at "

@@ -1,0 +1,17 @@
+"""Async windowed-retrain pipeline (docs/Pipeline.md).
+
+``RetrainPipeline`` overlaps host prep (labeling, featurization,
+binning) of window N+1 with device training of window N while a
+``PredictionServer`` keeps answering through atomic model swaps;
+``BinMapperCache`` persists bin boundaries across windows and re-runs
+find-bin only when the bin-occupancy drift statistic crosses its
+threshold, keeping program signatures — and therefore every compile
+cache — stable.
+"""
+
+from .bins import BinMapperCache
+from .core import (PipelineError, PreppedWindow, RetrainPipeline,
+                   WindowResult, densify_csr_rows)
+
+__all__ = ["BinMapperCache", "PipelineError", "PreppedWindow",
+           "RetrainPipeline", "WindowResult", "densify_csr_rows"]

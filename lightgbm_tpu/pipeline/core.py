@@ -1,0 +1,566 @@
+"""Async windowed-retrain pipeline: prep || train || serve.
+
+The fork exists to retrain a cache-admission model every sliding trace
+window (PAPER.md, ``src/test.cpp``): label, featurize, bin, train,
+predict — and the reference runs those phases strictly serially.  This
+module overlaps them into the production shape (docs/Pipeline.md):
+
+* **host prep** (labeling, featurization, CSR/dense -> binned via the
+  :class:`~lightgbm_tpu.pipeline.bins.BinMapperCache`) for window N+1
+  runs on ONE background thread, double-buffered (a bounded queue of
+  depth 1) against
+* **device training** of window N on the main thread — shapes held
+  stable by ``train_row_bucketing`` and the persistent mappers, so
+  cross-window retraces stay at zero and the grower re-dispatches into
+  cached programs, while
+* **serving** answers continuously from a
+  :class:`~lightgbm_tpu.serve.engine.PredictionServer`: the freshly
+  trained model lands via an atomic ``swap()`` (never a rebuild), and
+  the window is scored against the PREVIOUS model before training — the
+  reference's evaluateModel-then-trainModel order.
+
+Window policies (``window_policy``, selectable per window by passing a
+callable): ``fresh`` retrains from scratch (the reference's behaviour,
+byte-identical to a serial loop — see the determinism contract in
+docs/Pipeline.md); ``refit`` keeps the previous ensemble's routing
+structure and re-fits leaf values against the new labels with
+``refit_decay_rate`` (no new trees — the cheapest window); ``warm``
+refits, then continues boosting ``pipeline_warm_iterations`` new trees
+on top.  Both warm-start policies assign rows to leaves with the
+on-device binned traversal (``ops/traverse.py``) — exact because the
+mappers are the SAME objects across windows.
+
+Telemetry (``pipeline.*``, docs/Observability.md): per-window
+``pipeline.prep`` / ``pipeline.train`` / ``pipeline.eval`` /
+``pipeline.stall`` / ``pipeline.refit`` timings, the cumulative
+``pipeline.overlap_fraction`` gauge (overlapped prep seconds over total
+prep seconds, steady-state windows), ``pipeline.drift`` gauge, and
+``pipeline.windows`` / ``pipeline.rebinds`` counters.
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import obs
+from ..boosting import create_boosting
+from ..boosting.gbdt import GBDT
+from ..config import Config
+from ..utils.log import LightGBMError, log_warning
+from .bins import BinMapperCache
+
+POLICIES = ("fresh", "refit", "warm")
+
+
+class PipelineError(LightGBMError):
+    """A prep-stage failure, re-raised on the caller's thread.  Serving
+    is NOT torn down: the server keeps answering from the last good
+    model.  ``window`` is the failing window index; ``results`` holds
+    the windows completed before the failure."""
+
+    def __init__(self, window: int, results: List["WindowResult"],
+                 cause: BaseException):
+        super().__init__(f"pipeline prep failed at window {window}: "
+                         f"{cause!r}")
+        self.window = window
+        self.results = results
+        self.__cause__ = cause
+
+
+@dataclass
+class PreppedWindow:
+    """Everything host prep produces for one window.  Training features
+    are either ``dense`` (rows, features) or ``csr``
+    ``(indptr, indices, values, num_col)``; ``eval_*`` optionally carry
+    the rows the PREVIOUS model should be scored on before this
+    window's retrain (the reference's evaluateModel)."""
+
+    label: np.ndarray
+    dense: Optional[np.ndarray] = None
+    csr: Optional[Tuple] = None
+    eval_label: Optional[np.ndarray] = None
+    eval_dense: Optional[np.ndarray] = None
+    eval_csr: Optional[Tuple] = None
+    meta: dict = field(default_factory=dict)
+
+    @property
+    def num_rows(self) -> int:
+        if self.dense is not None:
+            return int(np.asarray(self.dense).shape[0])
+        return len(self.csr[0]) - 1
+
+    def has_eval(self) -> bool:
+        return self.eval_dense is not None or self.eval_csr is not None
+
+
+@dataclass
+class WindowResult:
+    window: int
+    policy: str
+    rebinned: bool
+    drift: Optional[float]
+    rows: int
+    num_trees: int
+    prep_s: float
+    stall_s: float
+    train_s: float
+    eval_s: float
+    swap_s: float
+    swap_same_shape: Optional[bool]
+    train_span: Tuple[float, float]
+    eval_metrics: Optional[dict]
+    meta: dict
+    booster: Optional[GBDT]
+
+    def to_json(self) -> dict:
+        """Per-window JSON line (booster and eval arrays omitted)."""
+        out = {
+            "window": self.window, "policy": self.policy,
+            "rebinned": self.rebinned,
+            "drift": None if self.drift is None else round(self.drift, 5),
+            "rows_trained": self.rows, "num_trees": self.num_trees,
+            "prep_s": round(self.prep_s, 3),
+            "stall_s": round(self.stall_s, 3),
+            "train_s": round(self.train_s, 3),
+            "eval_s": round(self.eval_s, 3),
+            "swap_s": round(self.swap_s, 4),
+            "swap_same_shape": self.swap_same_shape,
+        }
+        if self.eval_metrics:
+            out.update(self.eval_metrics)
+        out.update(self.meta)
+        return out
+
+
+def densify_csr_rows(csr: Tuple, lo: int, hi: int) -> np.ndarray:
+    """Dense (hi-lo, num_col) float64 block of CSR rows [lo, hi)."""
+    indptr, indices, values, num_col = csr
+    out = np.zeros((hi - lo, int(num_col)), np.float64)
+    p0, p1 = int(indptr[lo]), int(indptr[hi])
+    rows = np.repeat(np.arange(lo, hi),
+                     np.diff(np.asarray(indptr[lo:hi + 1])))
+    out[rows - lo, np.asarray(indices[p0:p1])] = values[p0:p1]
+    return out
+
+
+class RetrainPipeline:
+    """The windowed-retrain orchestrator (see module docstring).
+
+    ``params`` is a dict / ``key=value`` string / :class:`Config` with
+    the training configuration; pipeline knobs default from it
+    (``window_policy``, ``pipeline_rebin``,
+    ``pipeline_drift_threshold``, ``pipeline_warm_iterations``,
+    ``refit_decay_rate``, ``num_iterations``, ``fused_chunk``) and can
+    be overridden by keyword.  ``window_policy`` may be a callable
+    ``(window_index) -> str`` for per-window selection.
+    """
+
+    def __init__(self, params=None, *,
+                 num_iterations: Optional[int] = None,
+                 chunk: Optional[int] = None,
+                 window_policy=None,
+                 refit_decay_rate: Optional[float] = None,
+                 warm_iterations: Optional[int] = None,
+                 rebin_on_drift: Optional[bool] = None,
+                 drift_threshold: Optional[float] = None,
+                 categorical: Sequence[int] = (),
+                 pipelined: bool = True,
+                 serve: bool = True,
+                 server=None,
+                 eval_chunk_rows: int = 65536,
+                 warmup_rows="auto",
+                 keep_boosters: bool = True):
+        if isinstance(params, Config):
+            cfg = params
+        elif isinstance(params, str):
+            # accept both the C API's space-separated key=value string
+            # and the CLI config-file line format
+            from ..c_api import _tokenize_params
+            from ..config import parse_config_str
+            kv = parse_config_str(params)
+            kv.update(_tokenize_params(params))
+            cfg = Config(kv)
+        else:
+            cfg = Config(params or {})
+        self.config = cfg
+        self.num_iterations = int(num_iterations
+                                  if num_iterations is not None
+                                  else cfg.num_iterations)
+        self.chunk = int(chunk if chunk is not None
+                         else max(int(getattr(cfg, "fused_chunk", 20)), 1))
+        policy = (window_policy if window_policy is not None
+                  else getattr(cfg, "window_policy", "fresh"))
+        if not callable(policy):
+            if str(policy) not in POLICIES:
+                raise LightGBMError(f"unknown window_policy {policy!r}; "
+                                    f"expected one of {POLICIES}")
+            policy = str(policy)
+        self.window_policy = policy
+        self.refit_decay_rate = float(
+            refit_decay_rate if refit_decay_rate is not None
+            else getattr(cfg, "refit_decay_rate", 0.9))
+        warm = (warm_iterations if warm_iterations is not None
+                else int(getattr(cfg, "pipeline_warm_iterations", 0)))
+        self.warm_iterations = int(warm) if warm else self.num_iterations
+        self.bins = BinMapperCache(
+            drift_threshold=float(
+                drift_threshold if drift_threshold is not None
+                else getattr(cfg, "pipeline_drift_threshold", 0.1)),
+            rebin_on_drift=bool(
+                rebin_on_drift if rebin_on_drift is not None
+                else getattr(cfg, "pipeline_rebin", True)))
+        self.categorical = tuple(int(c) for c in categorical)
+        self.pipelined = bool(pipelined)
+        self.eval_chunk_rows = int(eval_chunk_rows)
+        self.server = server
+        if serve and self.server is None:
+            from ..serve.engine import PredictionServer
+            self.server = PredictionServer()
+        self.warmup_rows = warmup_rows
+        # False = drop each WindowResult's booster reference after
+        # on_window fires (long service loops would otherwise pin every
+        # window's device scores + binned matrix for the life of run();
+        # only the last model — final_booster() — and the served packed
+        # copy are needed at steady state)
+        self.keep_boosters = bool(keep_boosters)
+        self._prev: Optional[GBDT] = None
+        self._warmed = False
+        self._policy_fallback_logged = False
+        self._prep_thread: Optional[threading.Thread] = None
+        self._prep_queue: Optional[queue.Queue] = None
+        # overlap accounting (steady-state windows only)
+        self._prep_total_s = 0.0
+        self._overlap_s = 0.0
+
+    # -- prep stage ---------------------------------------------------
+    def _prep_window(self, payload, idx: int, prep_fn):
+        t0 = time.perf_counter()
+        with obs.span("pipeline.prep_window", cat="pipeline", window=idx):
+            pw = prep_fn(payload)
+            if not isinstance(pw, PreppedWindow):
+                raise LightGBMError(
+                    "prep_fn must return a PreppedWindow")
+            ds, info = self.bins.dataset_for(
+                self.config, dense=pw.dense, csr=pw.csr,
+                categorical=self.categorical, label=pw.label)
+        prep_s = time.perf_counter() - t0
+        obs.observe("pipeline.prep", prep_s)
+        return pw, ds, info, prep_s
+
+    def _window_stream(self, payloads, prep_fn, stop: threading.Event):
+        """Yield ``("window", idx, pw, ds, info, prep_s)`` items, then
+        ``("done",)`` — from a background thread when pipelined (queue
+        depth 1 = double buffering), inline otherwise.  Prep failures
+        travel as ``("error", idx, exc)``."""
+        if not self.pipelined:
+            def inline():
+                idx = -1
+                try:
+                    for idx, payload in enumerate(payloads):
+                        yield ("window", idx) + self._prep_window(
+                            payload, idx, prep_fn)
+                except Exception as e:   # noqa: BLE001 — surfaced below
+                    yield ("error", idx, e)
+                    return
+                yield ("done",)
+            return inline()
+
+        q: "queue.Queue" = queue.Queue(maxsize=1)
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker():
+            idx = -1
+            try:
+                for idx, payload in enumerate(payloads):
+                    if stop.is_set():
+                        return
+                    item = ("window", idx) + self._prep_window(
+                        payload, idx, prep_fn)
+                    if not put(item):
+                        return
+            except Exception as e:   # noqa: BLE001 — surfaced on main
+                put(("error", idx, e))
+                return
+            put(("done",))
+
+        t = threading.Thread(target=worker, name="lgbm-pipeline-prep",
+                             daemon=True)
+        t.start()
+        self._prep_thread = t
+        self._prep_queue = q
+
+        def drain():
+            while True:
+                yield q.get()
+
+        return drain()
+
+    # -- policies -----------------------------------------------------
+    def _policy_for(self, idx: int, rebinned: bool) -> str:
+        pol = (self.window_policy(idx) if callable(self.window_policy)
+               else self.window_policy)
+        if pol not in POLICIES:
+            raise LightGBMError(f"unknown window_policy {pol!r}")
+        if pol == "fresh":
+            return pol
+        fallback = None
+        if self._prev is None:
+            fallback = "no previous model"
+        elif rebinned:
+            fallback = "window was re-binned (leaf assignment needs the "
+            fallback += "previous mappers)"
+        elif type(self._prev) is not GBDT:
+            fallback = "previous booster is not plain gbdt"
+        if fallback is not None:
+            if not self._policy_fallback_logged:
+                log_warning(f"window_policy={pol}: falling back to "
+                            f"fresh ({fallback})")
+                self._policy_fallback_logged = True
+            return "fresh"
+        return pol
+
+    # -- training -----------------------------------------------------
+    def _train_fresh(self, ds) -> GBDT:
+        bst = create_boosting(self.config)
+        bst.init_train(ds)
+        bst.train_chunked(self.num_iterations,
+                          chunk=min(self.chunk, self.num_iterations))
+        return bst
+
+    def _leaf_assignments(self, trees, ds, learner):
+        """Per-tree leaf ids of ``ds``'s rows via the on-device binned
+        traversal — exact, because the mappers are shared objects
+        across windows (BinMapperCache)."""
+        from ..ops.traverse import device_tree, traverse
+        out = []
+        for tree in trees:
+            if tree.num_leaves <= 1:
+                out.append(None)
+                continue
+            dt = device_tree(tree, ds, self.config.num_leaves)
+            out.append(np.asarray(traverse(learner.traverse_binned, dt)))
+        return out
+
+    def _train_warm_start(self, ds, policy: str) -> GBDT:
+        """``refit``/``warm``: adopt DEEP COPIES of the previous
+        ensemble's trees, refit their leaf values against this window's
+        labels with decay, and (``warm``) continue boosting new trees
+        from the refit scores."""
+        prev = self._prev
+        prev._flush_pending()
+        bst = create_boosting(self.config)
+        bst.init_train(ds)
+        trees = [copy.deepcopy(t) for t in prev.models]
+        bst.models = trees
+        bst.iter = len(trees) // max(bst.num_model, 1)
+        with obs.span("pipeline.refit", cat="pipeline",
+                      trees=len(trees)) as sp:
+            leaf_ids = self._leaf_assignments(trees, ds, bst.learner)
+            label = np.asarray(ds.metadata.label, np.float64)
+            # the ONE refit implementation (GBDT.refit_leaves): with
+            # precomputed leaf assignments it rebuilds raw scores from
+            # leaf values and never touches raw features
+            bst.refit_leaves(None, label,
+                             decay_rate=self.refit_decay_rate,
+                             leaf_ids=leaf_ids)
+            sp.set(rows=len(label))
+        if policy == "warm":
+            # training scores of the REFIT model on this window (f64
+            # host accumulation, cast once — continued boosting corrects
+            # any representation difference on the next gradient step)
+            score = np.zeros((bst.num_model, ds.num_data), np.float64)
+            for idx, tree in enumerate(trees):
+                k = idx % bst.num_model
+                if leaf_ids[idx] is None:
+                    score[k] += float(tree.leaf_value[0])
+                else:
+                    score[k] += tree.leaf_value[leaf_ids[idx]]
+            import jax.numpy as jnp
+            bst.train_score = jnp.asarray(score, jnp.float32)
+            bst.train_chunked(self.warm_iterations,
+                              chunk=min(self.chunk, self.warm_iterations))
+        return bst
+
+    def _train_window(self, ds, policy: str) -> GBDT:
+        if policy == "fresh":
+            bst = self._train_fresh(ds)
+        else:
+            bst = self._train_warm_start(ds, policy)
+        bst._flush_pending()
+        obs.inc(f"pipeline.policy_{policy}")
+        self._prev = bst
+        return bst
+
+    # -- serving ------------------------------------------------------
+    def _swap(self, bst) -> Tuple[float, Optional[bool]]:
+        if self.server is None:
+            return 0.0, None
+        t0 = time.perf_counter()
+        first = self.server._model is None
+        same = self.server.swap(bst)
+        swap_s = time.perf_counter() - t0
+        obs.observe("pipeline.swap", swap_s)
+        if first and not self._warmed:
+            self._warmed = True
+            rows = self.warmup_rows
+            if rows == "auto":
+                rows = [min(self.eval_chunk_rows, 8192)]
+            if rows:
+                # precompile the eval buckets while window 1's prep is
+                # still running — the first real eval then re-dispatches
+                self.server.warmup(list(rows))
+        return swap_s, (None if first else same)
+
+    def _eval_window(self, pw: PreppedWindow, eval_fn):
+        """Score the CURRENTLY SERVED model (the previous window's) on
+        this window's eval rows — chunked through the server so serving
+        telemetry and row bucketing apply."""
+        if self.server is None or self.server._model is None \
+                or not pw.has_eval():
+            return None, 0.0
+        t0 = time.perf_counter()
+        with obs.span("pipeline.eval", cat="pipeline"):
+            if pw.eval_dense is not None:
+                n = int(np.asarray(pw.eval_dense).shape[0])
+                fetch = lambda lo, hi: np.asarray(  # noqa: E731
+                    pw.eval_dense[lo:hi], np.float64)
+            else:
+                n = len(pw.eval_csr[0]) - 1
+                fetch = lambda lo, hi: densify_csr_rows(  # noqa: E731
+                    pw.eval_csr, lo, hi)
+            preds = []
+            step = self.eval_chunk_rows
+            for lo in range(0, n, step):
+                hi = min(lo + step, n)
+                preds.append(np.asarray(self.server.predict(
+                    fetch(lo, hi))))
+            pred = np.concatenate(preds, axis=0) if preds \
+                else np.zeros(0)
+            metrics = eval_fn(pred, pw) if eval_fn is not None else None
+        eval_s = time.perf_counter() - t0
+        return metrics, eval_s
+
+    # -- the loop ------------------------------------------------------
+    def run(self, payloads, prep_fn: Callable,
+            eval_fn: Optional[Callable] = None,
+            on_window: Optional[Callable] = None) -> List[WindowResult]:
+        """Drive the pipeline over ``payloads`` (any iterable; each item
+        is handed to ``prep_fn(payload) -> PreppedWindow`` on the prep
+        thread).  ``eval_fn(pred, prepped) -> dict`` turns the served
+        model's predictions on a window's eval rows into metrics;
+        ``on_window(result)`` fires after every completed window.
+        Returns the list of :class:`WindowResult`.  A prep failure
+        raises :class:`PipelineError` — completed results ride on the
+        exception and the server keeps serving the last good model."""
+        if self._prep_thread is not None and self._prep_thread.is_alive():
+            # a previous run's worker is still mid-prep; letting a new
+            # one start would race it on the shared BinMapperCache
+            raise LightGBMError(
+                "a previous run()'s prep thread is still active; wait "
+                "for it to finish before starting another run")
+        obs.configure_from_config(self.config)
+        from .. import compile_cache
+        compile_cache.configure_from_config(self.config)
+        results: List[WindowResult] = []
+        stop = threading.Event()
+        stream = self._window_stream(payloads, prep_fn, stop)
+        try:
+            while True:
+                t_wait = time.perf_counter()
+                item = next(stream)
+                stall_s = time.perf_counter() - t_wait
+                if item[0] == "done":
+                    break
+                if item[0] == "error":
+                    _, idx, exc = item
+                    obs.inc("pipeline.prep_errors")
+                    raise PipelineError(idx, results, exc)
+                _, idx, pw, ds, info, prep_s = item
+                obs.observe("pipeline.stall", stall_s)
+                if idx > 0:
+                    self._prep_total_s += prep_s
+                    self._overlap_s += max(prep_s - stall_s, 0.0)
+                    if self._prep_total_s > 0:
+                        obs.set_gauge(
+                            "pipeline.overlap_fraction",
+                            self._overlap_s / self._prep_total_s)
+                with obs.span("pipeline.window", cat="pipeline",
+                              window=idx, rows=int(ds.num_data)):
+                    eval_metrics, eval_s = self._eval_window(pw, eval_fn)
+                    policy = self._policy_for(idx, info["rebinned"])
+                    t0 = time.perf_counter()
+                    # the span exit records the pipeline.train timing
+                    with obs.span("pipeline.train", cat="pipeline",
+                                  window=idx, policy=policy):
+                        bst = self._train_window(ds, policy)
+                    t1 = time.perf_counter()
+                    swap_s, same = self._swap(bst)
+                res = WindowResult(
+                    window=idx, policy=policy,
+                    rebinned=info["rebinned"], drift=info["drift"],
+                    rows=int(ds.num_data), num_trees=len(bst.models),
+                    prep_s=prep_s, stall_s=stall_s, train_s=t1 - t0,
+                    eval_s=eval_s, swap_s=swap_s, swap_same_shape=same,
+                    train_span=(t0, t1), eval_metrics=eval_metrics,
+                    meta=dict(pw.meta), booster=bst)
+                results.append(res)
+                obs.inc("pipeline.windows")
+                if on_window is not None:
+                    on_window(res)
+                if not self.keep_boosters:
+                    res.booster = None
+        finally:
+            stop.set()
+            self._shutdown_prep()
+        return results
+
+    def _shutdown_prep(self, timeout_s: float = 30.0) -> None:
+        """Wait for the prep worker to exit (its ``put`` loop notices
+        ``stop`` within 0.1 s; draining the queue unparks it).  A worker
+        deep inside a long ``prep_fn`` finishes that window first —
+        best effort, bounded; if it is somehow still alive afterwards
+        the thread reference is kept so the next ``run()`` refuses to
+        race it."""
+        worker = self._prep_thread
+        if worker is None:
+            return
+        deadline = time.perf_counter() + timeout_s
+        while worker.is_alive() and time.perf_counter() < deadline:
+            try:
+                self._prep_queue.get_nowait()
+            except queue.Empty:
+                pass
+            worker.join(timeout=0.2)
+        if worker.is_alive():
+            log_warning("pipeline prep thread did not stop within "
+                        f"{timeout_s:.0f} s; a new run() will refuse "
+                        "until it exits")
+        else:
+            self._prep_thread = None
+            self._prep_queue = None
+
+    @property
+    def overlap_fraction(self) -> Optional[float]:
+        """Overlapped prep seconds / total prep seconds across
+        steady-state windows (window 0 is inherently serial)."""
+        if self._prep_total_s <= 0:
+            return None
+        return self._overlap_s / self._prep_total_s
+
+    def final_booster(self) -> Optional[GBDT]:
+        return self._prev

@@ -39,13 +39,14 @@ step "jaxlint" python -m lightgbm_tpu.tools.jaxlint lightgbm_tpu \
     --baseline jaxlint_baseline.json
 
 # 2b. jaxlint with NO baseline over the modules that are debt-free
-#     today (stage-plan and the whole serve/ subsystem ship with zero
-#     findings): unlike step 2 — where a new finding in a file with
-#     baselined siblings still fails but the file's debt can only
-#     ratchet down — this step pins an absolute zero-findings contract
-#     for the listed files
+#     today (stage-plan and the whole serve/ and pipeline/ subsystems
+#     ship with zero findings): unlike step 2 — where a new finding in
+#     a file with baselined siblings still fails but the file's debt
+#     can only ratchet down — this step pins an absolute zero-findings
+#     contract for the listed files
 step "jaxlint (zero-debt modules)" python -m lightgbm_tpu.tools.jaxlint \
-    lightgbm_tpu/ops/stage_plan.py lightgbm_tpu/serve --no-baseline
+    lightgbm_tpu/ops/stage_plan.py lightgbm_tpu/serve \
+    lightgbm_tpu/pipeline --no-baseline
 
 # 3. the telemetry schema validator validates itself
 step "validate_metrics --self-test" \
@@ -62,6 +63,11 @@ if [[ "${1:-}" != "--fast" ]]; then
     #     (docs/ColdStart.md).  Spawns two XLA-compiling subprocesses,
     #     so it lives with the test runs, not the lint-speed --fast set
     step "coldstart smoke" python scripts/check_coldstart.py
+
+    # 5b. pipeline smoke: 3 synth windows through the async windowed-
+    #     retrain pipeline — zero retraces after window 1, serving
+    #     answers mid-train, swaps stay shape-stable (docs/Pipeline.md)
+    step "pipeline smoke" python scripts/check_pipeline.py
 
     tier1() {
         rm -f /tmp/_t1.log

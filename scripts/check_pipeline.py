@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""CI smoke for the async windowed-retrain pipeline (docs/Pipeline.md).
+
+Runs three same-shaped synthetic windows through
+``lightgbm_tpu.pipeline.RetrainPipeline`` with the device grower on and
+asserts the two contracts the subsystem exists for:
+
+1. **Zero retraces after window 1**: once the first window has compiled
+   the grower/serve/eval programs (the serve buckets are AOT-warmed at
+   the first swap), every later window re-dispatches into cached
+   programs — the obs-tracked jit compile total must not move between
+   the end of window 1 and the end of the run.
+
+2. **Serving never goes down**: a prober thread hammers
+   ``PredictionServer.predict`` throughout; at least one request must
+   succeed strictly INSIDE a later window's training interval (the
+   mid-train serve), every request must succeed, and the post-train
+   ``swap()`` must land shape-stable (``swap_same_shape=True``).
+
+Exit 0 on success, 1 with a diagnostic on failure.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+WINDOW_ROWS = 6000
+FEATURES = 10
+WINDOWS = 3
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+          "min_data_in_leaf": 5, "verbosity": -1, "metric": "none",
+          "device_growth": "on", "num_iterations": 6}
+
+
+def main() -> int:
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.pipeline import PreppedWindow, RetrainPipeline
+
+    obs.configure(enabled=True)
+
+    def make_window(seed):
+        rng = np.random.default_rng(seed)
+        x = rng.standard_normal((WINDOW_ROWS, FEATURES))
+        y = (x[:, 0] + 0.5 * x[:, 1]
+             + 0.2 * rng.standard_normal(WINDOW_ROWS) > 0).astype(
+            np.float64)
+        return x, y
+
+    def prep(w):
+        x, y = make_window(1000 + w)
+        return PreppedWindow(label=y, dense=x, eval_dense=x,
+                             eval_label=y)
+
+    def eval_fn(pred, pw):
+        err = float(np.mean((np.asarray(pred) >= 0.5)
+                            != (pw.eval_label >= 0.5)))
+        return {"prev_model_error": round(err, 4)}
+
+    pipe = RetrainPipeline(PARAMS, chunk=3)
+
+    probe_log = []          # (timestamp, ok)
+    probe_stop = threading.Event()
+    probe_rows = np.zeros((128, FEATURES))
+
+    def prober():
+        while not probe_stop.is_set():
+            t = time.perf_counter()
+            try:
+                out = pipe.server.predict(probe_rows)
+                ok = np.isfinite(np.asarray(out)).all()
+            except Exception:   # noqa: BLE001 — the smoke records it
+                ok = False
+            probe_log.append((t, bool(ok)))
+            time.sleep(0.02)
+
+    def compiles_now():
+        return sum(v["compiles"]
+                   for v in obs.registry().snapshot()["jit"].values())
+
+    state = {"compiles_after_w1": None, "prober": None}
+
+    def on_window(res):
+        if res.window == 0:
+            # warm the prober's bucket, then unleash it: every compile
+            # it needs exists before the window-1 boundary
+            pipe.server.warmup([probe_rows.shape[0]])
+            t = threading.Thread(target=prober, daemon=True)
+            t.start()
+            state["prober"] = t
+        elif res.window == 1:
+            state["compiles_after_w1"] = compiles_now()
+
+    try:
+        results = pipe.run(range(WINDOWS), prep, eval_fn=eval_fn,
+                           on_window=on_window)
+    finally:
+        probe_stop.set()
+        if state["prober"] is not None:
+            state["prober"].join(timeout=5.0)
+
+    failures = []
+    compiles_end = compiles_now()
+    if state["compiles_after_w1"] is None:
+        failures.append("window 1 never completed")
+    elif compiles_end != state["compiles_after_w1"]:
+        snap = obs.registry().snapshot()["jit"]
+        failures.append(
+            f"retraces after window 1: jit compiles went "
+            f"{state['compiles_after_w1']} -> {compiles_end} ({snap})")
+
+    if len(results) != WINDOWS:
+        failures.append(f"expected {WINDOWS} windows, got {len(results)}")
+    for res in results[1:]:
+        if res.swap_same_shape is not True:
+            failures.append(f"window {res.window} swap changed shape "
+                            f"(swap_same_shape={res.swap_same_shape})")
+
+    if not probe_log:
+        failures.append("prober made no requests")
+    elif not all(ok for _, ok in probe_log):
+        bad = sum(1 for _, ok in probe_log if not ok)
+        failures.append(f"{bad}/{len(probe_log)} serve probes failed")
+    else:
+        spans = [r.train_span for r in results[1:]]
+        mid_train = sum(1 for t, ok in probe_log
+                        if ok and any(t0 <= t <= t1 for t0, t1 in spans))
+        if mid_train == 0:
+            failures.append("no serve probe succeeded during a retrain "
+                            "(mid-train serving not demonstrated)")
+
+    summary = {
+        "windows": len(results),
+        "compiles_after_w1": state["compiles_after_w1"],
+        "compiles_end": compiles_end,
+        "probes": len(probe_log),
+        "mid_train_probes": sum(
+            1 for t, ok in probe_log
+            if ok and any(t0 <= t <= t1
+                          for t0, t1 in (r.train_span
+                                         for r in results[1:]))),
+        "overlap_fraction": pipe.overlap_fraction,
+        "rebinds": pipe.bins.rebinds,
+        "policies": [r.policy for r in results],
+        "errors": [r.eval_metrics for r in results],
+    }
+    print(json.dumps(summary))
+    if failures:
+        for f in failures:
+            print(f"PIPELINE SMOKE FAIL: {f}", file=sys.stderr)
+        return 1
+    print("pipeline smoke PASS: zero retraces after window 1, "
+          f"{summary['mid_train_probes']} mid-train serves, "
+          f"overlap {summary['overlap_fraction']:.3f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
