@@ -23,12 +23,20 @@ from ..metrics import create_metrics
 from ..objectives import create_objective
 from ..ops.grow import DeviceGrower, device_growth_eligible
 from ..ops.traverse import add_tree_score, device_tree
+from ..robust import checkpoint as _checkpoint
+from ..robust import faults
+from ..robust.retry import (RetryPolicy, transient_dispatch_errors,
+                            with_retries)
 from ..tree.tree import Tree
 from ..utils.log import LightGBMError, log_info, log_warning
 from ..parallel import create_tree_learner
 
 K_EPSILON = 1e-15
 MODEL_VERSION = "v2"
+
+#: dispatch errors worth a bounded retry (resolved once: the JAX
+#: runtime error type moved across versions)
+_TRANSIENT_DISPATCH = transient_dispatch_errors()
 
 
 class _ValidSet:
@@ -212,6 +220,9 @@ class GBDT:
         # executables instead of recompiling (docs/ColdStart.md)
         from .. import compile_cache
         compile_cache.configure_from_config(cfg)
+        # fault injection arms from params the same way (chaos/CI only;
+        # idempotent for an unchanged spec so windows share counters)
+        faults.configure_from_config(cfg)
         obs.inc("train.init_train")
         obs.instant("init_train", cat="boost",
                     rows=int(train_set.num_data),
@@ -494,6 +505,24 @@ class GBDT:
         grad, hess = self._adjust_gradients(grad, hess)
         return grad, hess, init_scores
 
+    def _dispatch_guard(self, fn):
+        """Run a device-dispatch thunk under the ``grow.dispatch`` fault
+        site with ``dispatch_retries`` bounded retries on TRANSIENT
+        runtime errors (accelerator preemption, a wedged runtime, an
+        injected fault).  Deterministic programs re-dispatch with
+        identical inputs, so a retry can never change results; anything
+        non-transient (shape/type errors) propagates immediately."""
+        def attempt():
+            faults.check("grow.dispatch")
+            return fn()
+        retries = int(getattr(self.config, "dispatch_retries", 2))
+        if retries <= 0:
+            return attempt()
+        policy = RetryPolicy(max_attempts=retries + 1, base_delay_s=0.05,
+                             max_delay_s=1.0,
+                             retry_on=_TRANSIENT_DISPATCH)
+        return with_retries(attempt, policy, site="grow.dispatch")
+
     def _train_one_iter_device(self) -> bool:
         if self._device_stop:
             return True
@@ -527,9 +556,9 @@ class GBDT:
             tree_idx = self.iter * self.num_model + k
             mask = self._grower.feature_mask_for(tree_idx)
             score, rec_i, rec_f, rec_c, nl, root_val, waves, qscale = \
-                self._grower.grow_one_iter(
+                self._dispatch_guard(lambda: self._grower.grow_one_iter(
                     self.train_score[k], grad[k], hess[k], mask, shrink,
-                    row_mask, tree_idx=tree_idx)
+                    row_mask, tree_idx=tree_idx))
             self.train_score = self.train_score.at[k].set(score)
             last_qscale = qscale
             self._wave_handles.append(waves)
@@ -587,11 +616,44 @@ class GBDT:
         """Whether train_chunked will actually fuse (public accessor)."""
         return self._fused_grad_fn() is not None
 
-    def train_chunked(self, n_iters: int, chunk: int = 20) -> bool:
+    def train_chunked(self, n_iters: int, chunk: int = 20,
+                      snapshot_freq: int = 0,
+                      snapshot_path: str = "") -> bool:
         """Train ``n_iters`` boosting iterations, fusing ``chunk`` whole
         iterations into one device dispatch when the configuration
-        allows; otherwise falls back to per-iteration training.  Returns
-        True when training stopped early (no more splittable leaves).
+        allows (see :meth:`_train_chunked_inner`); with
+        ``snapshot_freq > 0``, additionally cut each dispatch at the
+        snapshot boundaries and write an atomic checkpoint
+        (``<snapshot_path>.snapshot_iter_N`` + exact-score state
+        sidecar, :meth:`save_checkpoint`) every ``snapshot_freq``
+        iterations — a killed 500-iteration run then resumes from the
+        last snapshot (:meth:`resume_from_checkpoint`) instead of
+        iteration 0.  Returns True when training stopped early."""
+        freq = int(snapshot_freq)
+        if freq <= 0 or n_iters <= 0:
+            return self._train_chunked_inner(n_iters, chunk)
+        path = str(snapshot_path
+                   or self.config.output_model or "LightGBM_model.txt")
+        done = 0
+        while done < n_iters:
+            step = min(n_iters - done, freq - self.iter % freq)
+            before = self.iter
+            stopped = self._train_chunked_inner(step, chunk)
+            done += self.iter - before
+            if (self.iter > before and self.iter % freq == 0
+                    and not stopped):
+                with obs.span("train.snapshot", cat="boost",
+                              iteration=self.iter):
+                    self.save_checkpoint(
+                        f"{path}.snapshot_iter_{self.iter}")
+                obs.inc("train.snapshots")
+            if stopped:
+                return True
+        return False
+
+    def _train_chunked_inner(self, n_iters: int, chunk: int = 20) -> bool:
+        """The chunked training core (no snapshotting).  Returns True
+        when training stopped early (no more splittable leaves).
 
         The fused path exists because the per-iteration driver loop is
         host-latency-bound under CPU contention (each tree takes ~5
@@ -634,9 +696,10 @@ class GBDT:
             fused = self._grower.fused_train(chunk)
             t0 = time.perf_counter() if obs.enabled() else None
             score, (rec_i, rec_f, rec_c, nl, _root, waves, qscales) = \
-                fused(self._grower.binned, self._grower.binned_t,
-                      self.train_score[0], lr, gargs,
-                      jnp.asarray(self.iter, jnp.int32), grad_fn=grad_fn)
+                self._dispatch_guard(lambda: fused(
+                    self._grower.binned, self._grower.binned_t,
+                    self.train_score[0], lr, gargs,
+                    jnp.asarray(self.iter, jnp.int32), grad_fn=grad_fn))
             if t0 is not None:
                 self._obs_chunk(t0, chunk, score)
             self.train_score = self.train_score.at[0].set(score)
@@ -1215,6 +1278,69 @@ class GBDT:
         with open(filename, "w") as fh:
             fh.write(self.model_to_string(start_iteration, num_iteration))
         log_info(f"Finished saving model to file {filename}")
+
+    # ------------------------------------------------------------------
+    # training checkpoints (docs/Robustness.md)
+    def save_checkpoint(self, path: str) -> None:
+        """Atomic training checkpoint: the full model text at ``path``
+        plus a ``.state.npz`` sidecar carrying the EXACT float32
+        training scores and the iteration counter.  Both land via
+        write-temp-then-rename, so a crash mid-save leaves the previous
+        checkpoint intact."""
+        self._flush_pending()
+        _checkpoint.atomic_write_text(path, self.model_to_string())
+        # the host learner's feature_fraction stream is the one draw
+        # that is NOT (seed, iteration)-derived; snapshot it too
+        rng = getattr(getattr(self, "learner", None), "_rng", None)
+        _checkpoint.save_train_state(
+            path + ".state.npz",
+            np.asarray(self.train_score, np.float32), self.iter,
+            rng_state=rng.get_state() if rng is not None else None)
+        log_info(f"Saved training checkpoint to {path}")
+
+    def resume_from_checkpoint(self, path: str) -> "GBDT":
+        """Adopt a :meth:`save_checkpoint` snapshot AFTER
+        ``init_train``: the snapshot's trees replace the (empty) model
+        list, the sidecar restores the exact training scores, and the
+        bagging draw of the last redraw boundary is re-materialized —
+        continued boosting is then byte-identical to the uninterrupted
+        run (bagging / feature_fraction / quantization draws are all
+        (seed, iteration)-derived, so no RNG state needs saving)."""
+        if self.train_set is None:
+            raise LightGBMError(
+                "resume_from_checkpoint requires init_train first "
+                "(the training scores are sized by the dataset)")
+        state = _checkpoint.load_train_state(path + ".state.npz")
+        if state is None:
+            raise LightGBMError(
+                f"snapshot {path} has no state sidecar "
+                f"({path}.state.npz); cannot resume exactly — "
+                f"use input_model-style warm start instead")
+        score, it, rng_state = state
+        if score.shape != (self.num_model, self.num_data):
+            raise LightGBMError(
+                f"snapshot scores have shape {score.shape}, this "
+                f"dataset needs {(self.num_model, self.num_data)} — "
+                f"resume must use the SAME training data")
+        loaded = GBDT.load_model_from_file(path)
+        if len(loaded.models) != it * max(self.num_model, 1):
+            raise LightGBMError(
+                f"snapshot {path} holds {len(loaded.models)} trees but "
+                f"claims iteration {it}")
+        self.models = list(loaded.models)
+        self.iter = int(it)
+        self.train_score = jnp.asarray(score, jnp.float32)
+        self._device_stop = False
+        self._nl_queue.clear()
+        self._last_chunk_stack = None
+        rng = getattr(self.learner, "_rng", None)
+        if rng_state is not None and rng is not None:
+            rng.set_state(rng_state)
+        # per-iteration paths continue mid-stride: rebuild the bagging
+        # draw active after iteration (iter - 1)
+        self._sync_fused_bagging()
+        log_info(f"Resumed training from {path} (iteration {self.iter})")
+        return self
 
     # ------------------------------------------------------------------
     @classmethod

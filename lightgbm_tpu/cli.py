@@ -113,13 +113,26 @@ def run_train(cfg: Config):
     metric_freq = max(int(cfg.metric_freq), 1)
     fused_cap = max(int(getattr(cfg, "fused_chunk", 20)), 0)
     out_model = cfg.output_model or "LightGBM_model.txt"
+    if getattr(cfg, "resume_training", False):
+        # fault tolerance (docs/Robustness.md): adopt the newest
+        # snapshot whose exact-score sidecar exists and continue —
+        # byte-identical to the uninterrupted run
+        from .robust.checkpoint import latest_snapshot
+        snap = latest_snapshot(out_model)
+        if snap is not None:
+            booster.resume_from_checkpoint(snap)
+        else:
+            from .utils.log import log_warning
+            log_warning(f"resume_training requested but no resumable "
+                        f"{out_model}.snapshot_iter_* found; training "
+                        f"from scratch")
     start = time.time()
     # fused driving (GBDT.train_chunked): iterations between metric /
     # snapshot boundaries run as one device dispatch; per-iteration
     # fallback otherwise.  Boundary cadence — when metrics or snapshots
     # are due — is byte-identical to the per-iteration loop.
     can_fuse = fused_cap > 1 and booster.fused_eligible()
-    it = 0
+    it = booster.iter          # nonzero after resume_training
     while it < num_iters:
         step = 1
         if can_fuse:
@@ -150,7 +163,9 @@ def run_train(cfg: Config):
             log_info(f"{time.time() - start:.6f} seconds elapsed, "
                      f"finished iteration {j + 1}")
         if snapshot_freq > 0 and (it_done + 1) % snapshot_freq == 0:
-            booster.save_model_to_file(
+            # atomic model + exact-score state sidecar: the snapshot a
+            # killed run resumes from (resume_training=true / --resume)
+            booster.save_checkpoint(
                 f"{out_model}.snapshot_iter_{it_done + 1}")
         it += advanced
         if finished:
@@ -266,7 +281,26 @@ def run_pipeline(cfg: Config):
         return {"prev_model_rmse":
                 round(float(np.sqrt(np.mean((p - y) ** 2))), 6)}
 
-    pipe = RetrainPipeline(cfg, categorical=cats, keep_boosters=False)
+    ckpt_dir = str(getattr(cfg, "pipeline_checkpoint_dir", "") or "")
+    if getattr(cfg, "resume_training", False):
+        from .robust.checkpoint import has_pipeline_checkpoint
+        if not ckpt_dir:
+            raise LightGBMError(
+                "task=pipeline resume_training needs "
+                "pipeline_checkpoint_dir")
+        if has_pipeline_checkpoint(ckpt_dir):
+            pipe = RetrainPipeline.resume(ckpt_dir, cfg,
+                                          categorical=cats,
+                                          keep_boosters=False)
+        else:
+            from .utils.log import log_warning
+            log_warning(f"resume_training requested but no pipeline "
+                        f"checkpoint in {ckpt_dir}; starting at "
+                        f"window 0")
+            pipe = RetrainPipeline(cfg, categorical=cats,
+                                   keep_boosters=False)
+    else:
+        pipe = RetrainPipeline(cfg, categorical=cats, keep_boosters=False)
     results = pipe.run(payloads, prep, eval_fn=eval_fn,
                        on_window=lambda r: log_info(
                            "pipeline window " + json.dumps(r.to_json())))
@@ -295,6 +329,10 @@ def main(argv=None):
     # `lightgbm-tpu warmup|pipeline key=value...` subcommand sugar
     if argv and argv[0] in ("warmup", "pipeline"):
         argv = argv[1:] + [f"task={argv[0]}"]
+    # `--resume` sugar: continue a killed run from its last snapshot /
+    # pipeline checkpoint (docs/Robustness.md)
+    argv = ["resume_training=true" if a == "--resume" else a
+            for a in argv]
     params = parse_cli_args(argv)
     if not params:
         print("usage: python -m lightgbm_tpu config=train.conf [key=value...]\n"
@@ -306,6 +344,8 @@ def main(argv=None):
     # init_train too, but predict/convert/warmup configure here)
     from . import compile_cache
     compile_cache.configure_from_config(cfg)
+    from .robust import faults
+    faults.configure_from_config(cfg)
     task = cfg.task
     if task == "train":
         run_train(cfg)

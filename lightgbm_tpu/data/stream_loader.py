@@ -23,6 +23,7 @@ from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from ..robust import faults
 from ..utils.file_io import open_text
 from ..utils.log import LightGBMError, log_info
 from .parser import _atof, _sniff
@@ -30,37 +31,106 @@ from .parser import _atof, _sniff
 _CHUNK_BYTES = 8 << 20          # ~8 MB of text per chunk
 
 
-def _chunk_reader(path: str, skip_header: bool) -> Iterator[List[str]]:
-    """Yield lists of lines, double-buffered: a background thread reads
-    the next chunk from disk while the consumer parses the current one
-    (the ``PipelineReader`` pattern, utils/pipeline_reader.h:19-66)."""
+def _chunk_reader(path: str,
+                  skip_header: bool) -> Iterator[Tuple[int, List[str]]]:
+    """Yield ``(first_line_number, lines)`` chunks, double-buffered: a
+    background thread reads the next chunk from disk while the consumer
+    parses the current one (the ``PipelineReader`` pattern,
+    utils/pipeline_reader.h:19-66).  Line numbers are 1-based file
+    positions so parse errors can name the offending row.
+
+    Abandonment-safe (docs/Robustness.md): if the consumer stops early
+    — a parse error propagates, the generator is closed or collected —
+    the ``finally`` block trips ``stop`` and the reader's bounded put
+    notices within 0.1 s, so the thread can NEVER hang forever blocked
+    on the full queue (the failure mode of an unconditional
+    ``q.put``)."""
     q: "queue.Queue" = queue.Queue(maxsize=2)
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def reader():
+        line_no = 1
         try:
+            faults.check("io.read")
             with open_text(path) as fh:
                 if skip_header:
                     fh.readline()
+                    line_no += 1
                 while True:
                     lines = fh.readlines(_CHUNK_BYTES)
                     if not lines:
                         break
-                    q.put(lines)
+                    if not put((line_no, lines)):
+                        return
+                    line_no += len(lines)
         except Exception as e:    # noqa: BLE001 — forwarded to consumer
-            q.put(e)
-        finally:
-            q.put(None)
+            put(e)
+            return
+        put(None)
 
-    t = threading.Thread(target=reader, daemon=True)
+    t = threading.Thread(target=reader, daemon=True,
+                         name="lgbm-stream-reader")
     t.start()
-    while True:
-        item = q.get()
-        if item is None:
-            break
-        if isinstance(item, Exception):
-            raise item
-        yield item
-    t.join()
+    try:
+        while True:
+            item = q.get()
+            if item is None:
+                break
+            if isinstance(item, LightGBMError):
+                raise item
+            if isinstance(item, Exception):
+                raise LightGBMError(
+                    f"failed reading data file {path}: {item}") from item
+            yield item
+    finally:
+        stop.set()
+        # unpark a reader blocked on a full queue, then reap it
+        try:
+            q.get_nowait()
+        except queue.Empty:
+            pass
+        t.join(timeout=5.0)
+
+
+def _parse_chunk_checked(fmt: "_Format", path: str, line_no: int,
+                         lines: List[str], num_cols: int):
+    """``fmt.parse_chunk`` with failure context: a poisoned row (bad
+    float, truncated ``feat:value`` token, ragged line) surfaces as a
+    :class:`LightGBMError` naming the FILE and LINE instead of a bare
+    ``ValueError`` from deep inside numpy."""
+    try:
+        faults.check("stream.parse")
+        return fmt.parse_chunk(lines, num_cols)
+    except LightGBMError:
+        raise
+    except Exception as e:      # noqa: BLE001 — re-raised with location
+        row = _locate_bad_line(fmt, lines, num_cols)
+        where = (f"line {line_no + row}: {lines[row].rstrip()!r}"
+                 if row is not None
+                 else f"lines {line_no}-{line_no + len(lines) - 1}")
+        raise LightGBMError(
+            f"failed to parse data file {path} at {where} "
+            f"(truncated or malformed row?): {e}") from e
+
+
+def _locate_bad_line(fmt: "_Format", lines: List[str],
+                     num_cols: int) -> Optional[int]:
+    """Error-path-only bisect: which single line fails to parse."""
+    for i, line in enumerate(lines):
+        try:
+            fmt.parse_chunk([line], num_cols)
+        except Exception:       # noqa: BLE001 — probing
+            return i
+    return None
 
 
 class _Format:
@@ -158,8 +228,9 @@ def iter_parsed_chunks(path: str, config, num_features: int):
     chunks behind the double-buffered reader.  Used by the CLI's
     streaming prediction (``predictor.hpp:170-259`` analog)."""
     fmt = _Format(path, config)
-    for lines in _chunk_reader(path, fmt.header):
-        yield fmt.parse_chunk(lines, num_features)
+    for line_no, lines in _chunk_reader(path, fmt.header):
+        yield _parse_chunk_checked(fmt, path, line_no, lines,
+                                   num_features)
 
 
 def load_text_two_round(path: str, config, categorical=(),
@@ -182,11 +253,17 @@ def load_text_two_round(path: str, config, categorical=(),
     num_cols = fmt.num_cols
     reservoir: Optional[np.ndarray] = None      # (sample, F) float64
     res_filled = 0
-    for lines in _chunk_reader(path, fmt.header):
+    for line_no, lines in _chunk_reader(path, fmt.header):
         if fmt.kind == "libsvm":
-            num_cols = max(num_cols, fmt.scan_columns(lines))
+            try:
+                num_cols = max(num_cols, fmt.scan_columns(lines))
+            except Exception as e:   # noqa: BLE001 — located below
+                raise LightGBMError(
+                    f"failed to parse data file {path} near line "
+                    f"{line_no} (truncated feature:value token?): "
+                    f"{e}") from e
             fmt.num_cols = num_cols
-        x, _ = fmt.parse_chunk(lines, num_cols)
+        x, _ = _parse_chunk_checked(fmt, path, line_no, lines, num_cols)
         if reservoir is None:
             reservoir = np.zeros((sample_cnt_target, x.shape[1]))
         elif x.shape[1] > reservoir.shape[1]:   # libsvm column growth
@@ -228,8 +305,8 @@ def load_text_two_round(path: str, config, categorical=(),
     # ---- round two: bin chunk-wise into the (N, G) matrix --------------
     start = 0
     label = np.zeros(n_total, np.float64)
-    for lines in _chunk_reader(path, fmt.header):
-        x, y = fmt.parse_chunk(lines, num_cols)
+    for line_no, lines in _chunk_reader(path, fmt.header):
+        x, y = _parse_chunk_checked(fmt, path, line_no, lines, num_cols)
         ds.construct_streaming_push(x, start)
         label[start:start + len(y)] = y
         start += x.shape[0]

@@ -318,6 +318,24 @@ def summary() -> Dict:
             "swaps": snap["counters"].get("serve.swaps", 0),
             "rows": snap["counters"].get("serve.rows", 0),
         }
+    injected = sum(v for k, v in snap["counters"].items()
+                   if k.startswith("fault."))
+    retries = snap["counters"].get("retry.attempts", 0)
+    fallback = snap["counters"].get("serve.fallback_requests", 0)
+    if injected or retries or fallback:
+        degraded = snap["timings"].get("serve.degraded_time")
+        out["robust"] = {
+            "faults_injected": injected,
+            "retry_attempts": retries,
+            "fallback_requests": fallback,
+            "device_failures": snap["counters"].get(
+                "serve.device_failures", 0),
+            "degraded": snap["gauges"].get("serve.degraded"),
+            "degraded_time_s": round(degraded["total_s"], 3)
+            if degraded else 0.0,
+            "checkpoints": snap["counters"].get(
+                "pipeline.checkpoints", 0),
+        }
     windows = snap["counters"].get("pipeline.windows", 0)
     if windows:
         prep = snap["timings"].get("pipeline.prep")

@@ -31,6 +31,9 @@ is ``Network(mesh=...)`` accepting any existing ``jax.sharding.Mesh``.
 from __future__ import annotations
 
 import functools
+import socket
+import struct
+import time
 from typing import Optional
 
 import jax
@@ -38,6 +41,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..robust import faults
+from ..robust.retry import RetryError, RetryPolicy, with_retries
 from ..utils.log import LightGBMError, log_info
 
 AXIS = "workers"
@@ -181,6 +186,167 @@ class Network:
         wrappers above make collective use explicit)."""
         return jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
                              out_specs=out_specs, check_vma=False)
+
+
+# ---------------------------------------------------------------------------
+# fault-tolerant point-to-point helpers (the host-blob plane)
+# ---------------------------------------------------------------------------
+# XLA owns the on-device collectives above, but multi-controller
+# bring-up still rides plain TCP: the jax.distributed coordinator
+# handshake, and any embedder exchanging serialized mappers / machine
+# lists over its own sockets (the reference's Linkers).  The reference
+# blocks forever on a dead peer (linkers_socket.cpp Construct/Recv);
+# these helpers bound every operation with a timeout and give connects
+# capped-backoff retries, so a missing worker fails the mesh FAST and
+# with context instead of hanging it (docs/Robustness.md).
+
+DEFAULT_NETWORK_TIMEOUT_S = 30.0
+DEFAULT_NETWORK_RETRIES = 5
+#: recv_bytes length-prefix sanity bound: a corrupt/misbehaving peer
+#: must produce a bounded protocol error, not a giant allocation
+MAX_MESSAGE_BYTES = 1 << 30
+
+_LEN_PREFIX = struct.Struct("<Q")
+
+
+def connect_with_retries(host: str, port: int, *,
+                         attempts: Optional[int] = None,
+                         timeout_s: Optional[float] = None,
+                         base_delay_s: float = 0.1,
+                         config=None, sleep=time.sleep) -> socket.socket:
+    """TCP connect with ``attempts`` bounded tries and capped
+    exponential backoff; raises a clear "peer unreachable after N
+    attempts" :class:`LightGBMError` instead of hanging the worker
+    mesh.  The returned socket keeps ``timeout_s`` as its per-op
+    timeout.  Explicit arguments win; otherwise ``config``'s
+    ``network_retries`` / ``network_timeout`` params apply, then the
+    schema defaults."""
+    cfg_attempts, cfg_timeout = network_policy_from_config(config)
+    if attempts is None:
+        attempts = cfg_attempts
+    if timeout_s is None:
+        timeout_s = cfg_timeout
+    attempts = max(int(attempts), 1)
+
+    def attempt():
+        faults.check("net.connect")
+        return socket.create_connection((host, int(port)),
+                                        timeout=float(timeout_s))
+
+    policy = RetryPolicy(max_attempts=attempts,
+                         base_delay_s=float(base_delay_s),
+                         max_delay_s=2.0,
+                         retry_on=(OSError, faults.InjectedFault))
+    try:
+        sock = with_retries(attempt, policy, site="net.connect",
+                            sleep=sleep)
+    except RetryError as e:
+        raise LightGBMError(
+            f"peer {host}:{port} unreachable after {attempts} "
+            f"attempt{'s' if attempts != 1 else ''} (last error: "
+            f"{e.__cause__!r}); check the machine list / coordinator "
+            f"address and that the peer process is up") from e
+    sock.settimeout(float(timeout_s))
+    return sock
+
+
+def wait_for_peer(address: str, *, attempts: Optional[int] = None,
+                  timeout_s: Optional[float] = None,
+                  base_delay_s: float = 0.1, config=None,
+                  sleep=time.sleep) -> None:
+    """Probe a ``host:port`` peer (e.g. the ``jax.distributed``
+    coordinator) until it accepts a connection, then close — called
+    BEFORE ``jax.distributed.initialize`` so a dead/mistyped
+    coordinator fails fast with a clear error instead of stalling the
+    whole mesh inside the runtime's own (much longer) handshake."""
+    host, _, port = str(address).rpartition(":")
+    if not host or not port.isdigit():
+        raise LightGBMError(
+            f"bad peer address {address!r} (expected host:port)")
+    sock = connect_with_retries(host, int(port), attempts=attempts,
+                                timeout_s=timeout_s,
+                                base_delay_s=base_delay_s,
+                                config=config, sleep=sleep)
+    sock.close()
+
+
+def _netop(sock: socket.socket, site: str, timeout_s: Optional[float],
+           fn, what: str):
+    """Shared wrapper for send/recv: fault site, optional per-op
+    timeout override, and timeout/OS errors re-raised with context."""
+    faults.check(site)
+    if timeout_s is not None:
+        sock.settimeout(float(timeout_s))
+    try:
+        return fn()
+    except socket.timeout as e:
+        peer = _peer_name(sock)
+        raise LightGBMError(
+            f"network timeout {what} {peer} (after "
+            f"{sock.gettimeout():g} s); peer dead or partitioned — "
+            f"the mesh should be rebuilt") from e
+    except OSError as e:
+        peer = _peer_name(sock)
+        raise LightGBMError(f"network error {what} {peer}: {e}") from e
+
+
+def _peer_name(sock: socket.socket) -> str:
+    try:
+        addr = sock.getpeername()
+    except OSError:
+        return "peer <unknown>"
+    if isinstance(addr, tuple) and len(addr) >= 2:
+        return f"peer {addr[0]}:{addr[1]}"
+    return f"peer {addr!r}"     # AF_UNIX etc.
+
+
+def send_bytes(sock: socket.socket, payload: bytes,
+               timeout_s: Optional[float] = None) -> None:
+    """Length-prefixed blocking send with a bounded timeout (the
+    reference's ``Linkers::Send`` had none)."""
+    def run():
+        sock.sendall(_LEN_PREFIX.pack(len(payload)))
+        sock.sendall(payload)
+    _netop(sock, "net.send", timeout_s, run, "sending to")
+
+
+def recv_bytes(sock: socket.socket,
+               timeout_s: Optional[float] = None) -> bytes:
+    """Length-prefixed blocking recv with a bounded timeout; a peer
+    closing mid-message raises instead of returning a short read."""
+    def read_exact(n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            # cap the per-call request so a large n never asks the
+            # kernel for one giant buffer
+            chunk = sock.recv(min(n - len(buf), 1 << 20))
+            if not chunk:
+                raise LightGBMError(
+                    f"connection closed by {_peer_name(sock)} "
+                    f"mid-message ({len(buf)}/{n} bytes)")
+            buf.extend(chunk)
+        return bytes(buf)
+
+    def run():
+        (length,) = _LEN_PREFIX.unpack(read_exact(_LEN_PREFIX.size))
+        if length > MAX_MESSAGE_BYTES:
+            # corrupt / torn / hostile prefix: a bounded protocol
+            # error with context, never a giant allocation
+            raise LightGBMError(
+                f"{_peer_name(sock)} announced a {length}-byte message "
+                f"(limit {MAX_MESSAGE_BYTES}); corrupt length prefix "
+                f"or protocol mismatch")
+        return read_exact(length)
+    return _netop(sock, "net.recv", timeout_s, run, "receiving from")
+
+
+def network_policy_from_config(config):
+    """(attempts, timeout_s) from a Config's ``network_retries`` /
+    ``network_timeout`` params (schema defaults otherwise)."""
+    return (int(getattr(config, "network_retries",
+                        DEFAULT_NETWORK_RETRIES)),
+            float(getattr(config, "network_timeout",
+                          DEFAULT_NETWORK_TIMEOUT_S)))
 
 
 @functools.lru_cache(maxsize=8)

@@ -361,6 +361,38 @@ PARAM_SCHEMA: Sequence[Param] = (
             "path at the end of train() (implies metrics_enabled). Open at "
             "https://ui.perfetto.dev. Env override: LGBM_TPU_TRACE=<path>",
        section="io"),
+    _p("pipeline_checkpoint_dir", str, "", (),
+       desc="windowed pipeline: directory for per-window fault-tolerance "
+            "checkpoints (docs/Robustness.md). After every completed "
+            "window the pipeline atomically persists the trained model, "
+            "the bin-mapper cache and a manifest (write-temp-then-"
+            "rename; the manifest is the commit point), so a killed run "
+            "resumes from the last completed window via "
+            "resume_training=true / RetrainPipeline.resume(dir). Empty "
+            "disables checkpointing", section="io"),
+    _p("resume_training", bool, False, ("resume",),
+       desc="resume an interrupted run instead of starting over "
+            "(docs/Robustness.md). task=train: adopt the highest "
+            "<output_model>.snapshot_iter_N whose .state.npz sidecar "
+            "exists and continue boosting from it — byte-identical to "
+            "the uninterrupted run because the sidecar restores the "
+            "exact float32 training scores. task=pipeline: reload "
+            "pipeline_checkpoint_dir's manifest and continue at the "
+            "first uncheckpointed window. CLI sugar: --resume. Warns "
+            "and trains from scratch when nothing resumable exists",
+       section="io"),
+    _p("fault_spec", str, "", (),
+       desc="deterministic fault injection for chaos testing "
+            "(docs/Robustness.md): comma-separated "
+            "site[:key=value|persist]* entries armed at the named "
+            "sites (grow.dispatch, serve.dispatch, pipeline.prep, "
+            "net.connect, io.write, ...), e.g. "
+            "'serve.dispatch:persist' or 'pipeline.prep:at=2'. Modes: "
+            "n= (first N calls), at= (exact invocation), after=, "
+            "p=/seed= (seed-keyed probabilistic, reproducible), "
+            "persist; error=fault/oserror/timeout picks the raised "
+            "flavor. Env override: LGBM_TPU_FAULTS. NEVER set in "
+            "production", section="io"),
 
     # -- objective --------------------------------------------------------
     _p("num_class", int, 1, ("num_classes",), check="> 0",
@@ -415,6 +447,19 @@ PARAM_SCHEMA: Sequence[Param] = (
        desc="machine list file (compat; unused on TPU)", section="network"),
     _p("machines", str, "", ("workers", "nodes"),
        desc="machine list (compat; unused on TPU)", section="network"),
+    _p("network_timeout", float, 30.0, (), check="> 0.0",
+       desc="per-operation socket timeout in SECONDS for the host-level "
+            "point-to-point helpers (parallel/network.py connect/send/"
+            "recv and the jax.distributed coordinator probe): a dead "
+            "peer fails the operation with context instead of blocking "
+            "the worker mesh forever. Distinct from the reference's "
+            "time_out (minutes; kept for config compatibility, unused)",
+       section="network"),
+    _p("network_retries", int, 5, (), check="> 0",
+       desc="max connect attempts (first try included) for the "
+            "point-to-point helpers, with capped exponential backoff "
+            "between attempts; exhausting them raises 'peer unreachable "
+            "after N attempts' instead of hanging", section="network"),
 
     # -- device -----------------------------------------------------------
     _p("gpu_platform_id", int, -1, (), desc="compat; ignored", section="device"),
@@ -561,6 +606,15 @@ PARAM_SCHEMA: Sequence[Param] = (
             "UpdateChunked) cap each dispatch at the next callback/eval/"
             "snapshot boundary so observable cadence is unchanged; <= 1 "
             "disables fusing", section="device"),
+    _p("dispatch_retries", int, 2, (), check=">= 0",
+       desc="bounded retries (with short backoff) around a device "
+            "dispatch that raises a TRANSIENT runtime error (the JAX "
+            "runtime error type, OSError/TimeoutError, and injected "
+            "faults) before the failure propagates — a preempted or "
+            "briefly wedged accelerator gets dispatch_retries more "
+            "chances; deterministic programs re-dispatch identically "
+            "so a retry never changes results. 0 disables",
+       section="device"),
     _p("deterministic", bool, True, (),
        desc="bit-deterministic device reductions where possible", section="device"),
 )

@@ -53,7 +53,9 @@ from .. import obs
 from ..boosting import create_boosting
 from ..boosting.gbdt import GBDT
 from ..config import Config
-from ..utils.log import LightGBMError, log_warning
+from ..robust import checkpoint as _checkpoint
+from ..robust import faults
+from ..utils.log import LightGBMError, log_info, log_warning
 from .bins import BinMapperCache
 
 POLICIES = ("fresh", "refit", "warm")
@@ -176,7 +178,8 @@ class RetrainPipeline:
                  server=None,
                  eval_chunk_rows: int = 65536,
                  warmup_rows="auto",
-                 keep_boosters: bool = True):
+                 keep_boosters: bool = True,
+                 checkpoint_dir: Optional[str] = None):
         if isinstance(params, Config):
             cfg = params
         elif isinstance(params, str):
@@ -230,6 +233,13 @@ class RetrainPipeline:
         # only the last model — final_booster() — and the served packed
         # copy are needed at steady state)
         self.keep_boosters = bool(keep_boosters)
+        # fault tolerance (docs/Robustness.md): after every completed
+        # window the model + bin mappers + a manifest land atomically in
+        # checkpoint_dir; resume(dir) continues at the next window
+        self.checkpoint_dir = str(
+            checkpoint_dir if checkpoint_dir is not None
+            else getattr(cfg, "pipeline_checkpoint_dir", "") or "") or None
+        self._start_window = 0
         self._prev: Optional[GBDT] = None
         self._warmed = False
         self._policy_fallback_logged = False
@@ -239,10 +249,61 @@ class RetrainPipeline:
         self._prep_total_s = 0.0
         self._overlap_s = 0.0
 
+    # -- checkpoint / resume ------------------------------------------
+    @classmethod
+    def resume(cls, checkpoint_dir: str, params=None, **kwargs
+               ) -> "RetrainPipeline":
+        """Rebuild a pipeline from a checkpoint directory: the last
+        completed window's model becomes ``_prev`` (so serving and the
+        warm-start policies continue from it), the bin-mapper cache is
+        restored (so later windows stay shape-stable against the SAME
+        reference mappers), and ``run()`` skips every window the
+        checkpoint already covers — under a deterministic config
+        (``pipeline_rebin=false``, ``window_policy=fresh``) the resumed
+        run's final model is byte-identical to an uninterrupted one."""
+        cp = _checkpoint.load_pipeline_checkpoint(checkpoint_dir)
+        if cp is None:
+            raise LightGBMError(
+                f"no pipeline checkpoint manifest in {checkpoint_dir}")
+        kwargs.setdefault("checkpoint_dir", checkpoint_dir)
+        pipe = cls(params, **kwargs)
+        if cp.bins_path:
+            loaded = BinMapperCache.load(
+                cp.bins_path, rebin_on_drift=pipe.bins.rebin_on_drift)
+            loaded.drift_threshold = pipe.bins.drift_threshold
+            pipe.bins = loaded
+        model_str = cp.model_string()
+        if model_str:
+            pipe._prev = GBDT.load_model_from_string(
+                model_str, pipe.config.clone())
+            if pipe.server is not None:
+                # serving restarts WITH the last good model: the first
+                # resumed window is test-then-train scored against it,
+                # exactly as if the process had never died
+                pipe._swap(pipe._prev)
+        pipe._start_window = cp.window + 1
+        log_info(f"Resuming pipeline at window {pipe._start_window} "
+                 f"(checkpoint {checkpoint_dir})")
+        return pipe
+
+    def _save_checkpoint(self, idx: int, bst: GBDT, policy: str,
+                         rows: int) -> None:
+        t0 = time.perf_counter()
+        _checkpoint.save_pipeline_checkpoint(
+            self.checkpoint_dir, window=idx,
+            model_str=bst.model_to_string(),
+            bins=self.bins,
+            meta={"policy": policy, "rows": int(rows),
+                  "num_trees": len(bst.models),
+                  "num_iterations": self.num_iterations})
+        obs.observe("pipeline.checkpoint", time.perf_counter() - t0)
+        obs.inc("pipeline.checkpoints")
+
     # -- prep stage ---------------------------------------------------
     def _prep_window(self, payload, idx: int, prep_fn):
         t0 = time.perf_counter()
         with obs.span("pipeline.prep_window", cat="pipeline", window=idx):
+            faults.check("pipeline.prep")
             pw = prep_fn(payload)
             if not isinstance(pw, PreppedWindow):
                 raise LightGBMError(
@@ -259,11 +320,14 @@ class RetrainPipeline:
         ``("done",)`` — from a background thread when pipelined (queue
         depth 1 = double buffering), inline otherwise.  Prep failures
         travel as ``("error", idx, exc)``."""
+        start = self._start_window
         if not self.pipelined:
             def inline():
                 idx = -1
                 try:
                     for idx, payload in enumerate(payloads):
+                        if idx < start:    # resumed: already completed
+                            continue
                         yield ("window", idx) + self._prep_window(
                             payload, idx, prep_fn)
                 except Exception as e:   # noqa: BLE001 — surfaced below
@@ -289,6 +353,8 @@ class RetrainPipeline:
                 for idx, payload in enumerate(payloads):
                     if stop.is_set():
                         return
+                    if idx < start:        # resumed: already completed
+                        continue
                     item = ("window", idx) + self._prep_window(
                         payload, idx, prep_fn)
                     if not put(item):
@@ -397,6 +463,7 @@ class RetrainPipeline:
         return bst
 
     def _train_window(self, ds, policy: str) -> GBDT:
+        faults.check("pipeline.train")
         if policy == "fresh":
             bst = self._train_fresh(ds)
         else:
@@ -474,6 +541,7 @@ class RetrainPipeline:
                 "a previous run()'s prep thread is still active; wait "
                 "for it to finish before starting another run")
         obs.configure_from_config(self.config)
+        faults.configure_from_config(self.config)
         from .. import compile_cache
         compile_cache.configure_from_config(self.config)
         results: List[WindowResult] = []
@@ -510,6 +578,11 @@ class RetrainPipeline:
                         bst = self._train_window(ds, policy)
                     t1 = time.perf_counter()
                     swap_s, same = self._swap(bst)
+                    if self.checkpoint_dir:
+                        # commit the completed window AFTER serving has
+                        # it: a crash from here on resumes at idx + 1
+                        self._save_checkpoint(idx, bst, policy,
+                                              int(ds.num_data))
                 res = WindowResult(
                     window=idx, policy=policy,
                     rebinned=info["rebinned"], drift=info["drift"],

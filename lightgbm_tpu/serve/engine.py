@@ -18,13 +18,25 @@ that read side:
   ``max_wait_ms``, amortizing dispatch overhead under concurrent
   callers;
 * ``warmup(...)`` precompiles the configured row buckets so the first
-  real request never pays a trace+compile.
+  real request never pays a trace+compile;
+* **graceful degradation** (docs/Robustness.md): when the device
+  dispatch fails (preemption, runtime death — or the ``serve.dispatch``
+  injected fault), the batch is answered by the HOST ``Tree.predict``
+  walk over the same served tree slice (float64, byte-identical to
+  ``Booster.predict``'s host path), a circuit breaker trips after
+  ``failure_threshold`` consecutive device failures so later requests
+  skip the dead device entirely, and a timed re-probe recovers to the
+  device path once it heals — injected device death drops ZERO
+  requests.
 
 Telemetry (all under the ``serve.`` prefix, see docs/Observability.md):
 ``serve.predict`` / ``serve.queue_wait`` / ``serve.request_latency``
 timings (p50/p95 come from the registry), ``serve.batch_rows`` gauge,
 ``serve.swaps`` / ``serve.requests`` / ``serve.rows`` /
-``serve.device_batches`` counters.
+``serve.device_batches`` counters; degradation adds the
+``serve.degraded`` gauge (1 while the breaker is open),
+``serve.device_failures`` / ``serve.fallback_requests`` counters and
+the ``serve.degraded_time`` timing (seconds per dark period).
 """
 
 from __future__ import annotations
@@ -38,8 +50,11 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import obs
-from ..utils.log import LightGBMError
-from .packed import PackedEnsemble, pack_gbdt, predict_scores, row_bucket
+from ..robust import faults
+from ..robust.retry import CircuitBreaker
+from ..utils.log import LightGBMError, log_warning
+from .packed import (PackedEnsemble, pack_gbdt, predict_scores,
+                     row_bucket, tree_slice)
 
 __all__ = ["PredictionServer", "warmup_bucket_ladder"]
 
@@ -75,17 +90,31 @@ def _as_gbdt(booster):
 
 class _Model:
     """One immutable generation of the served model: the packed
-    ensemble plus the output conversion the booster would apply."""
+    ensemble, the output conversion the booster would apply, and (for
+    the degrade path) the host ``Tree`` objects of the SAME served
+    slice so a dead device never drops a request."""
 
     __slots__ = ("packed", "objective", "objective_str", "average_output",
-                 "n_iters")
+                 "n_iters", "host_trees", "num_model")
 
-    def __init__(self, packed: PackedEnsemble, gbdt):
+    def __init__(self, packed: PackedEnsemble, gbdt, host_trees=None):
         self.packed = packed
         self.objective = gbdt.objective
         self.objective_str = gbdt.loaded_objective_str
         self.average_output = bool(gbdt.average_output)
         self.n_iters = packed.num_iterations
+        self.host_trees = host_trees
+        self.num_model = max(int(packed.num_model), 1)
+
+    def host_raw(self, data: np.ndarray) -> np.ndarray:
+        """(K, rows) float64 raw scores via the host tree walk — the
+        exact accumulation ``GBDT.predict_raw``'s host path performs
+        over this slice, so fallback answers match ``Booster.predict``
+        byte for byte."""
+        out = np.zeros((self.num_model, data.shape[0]), np.float64)
+        for i, tree in enumerate(self.host_trees):
+            out[i % self.num_model] += tree.predict(data)
+        return out
 
     def convert(self, raw: np.ndarray, raw_score: bool) -> np.ndarray:
         """(K, R) raw -> user-facing values, matching GBDT.predict."""
@@ -111,12 +140,19 @@ class PredictionServer:
     ``num_iteration``/``start_iteration`` select the served tree slice
     (applied on every swap).  ``max_batch``/``max_wait_ms`` configure
     the optional micro-batching queue (``start()``/``submit()``).
+
+    ``host_fallback`` (default on) keeps the served slice's host trees
+    so device-dispatch failures degrade to the host walk instead of
+    dropping requests; ``breaker`` overrides the default circuit
+    breaker (3 consecutive failures trip it, re-probe every 2 s).
     """
 
     def __init__(self, booster=None, *, num_iteration: int = -1,
                  start_iteration: int = 0, max_batch: int = 8192,
                  max_wait_ms: float = 2.0, min_bucket: int = 128,
-                 device_predict_min_rows: Optional[int] = None):
+                 device_predict_min_rows: Optional[int] = None,
+                 host_fallback: bool = True,
+                 breaker: Optional[CircuitBreaker] = None):
         # serving restarts cold too: pick up the persistent compile
         # cache from the environment so the packed traversal programs
         # load from disk (docs/ColdStart.md)
@@ -137,11 +173,20 @@ class PredictionServer:
         self.device_predict_min_rows = (
             None if device_predict_min_rows is None
             else int(device_predict_min_rows))
+        self.host_fallback = bool(host_fallback)
+        self._breaker = breaker if breaker is not None else \
+            CircuitBreaker(failure_threshold=3, reprobe_interval_s=2.0)
         self._queue: Queue = Queue()
         self._worker: Optional[threading.Thread] = None
         self._stopping = threading.Event()
         if booster is not None:
             self.swap(booster)
+
+    @property
+    def degraded(self) -> bool:
+        """True while the circuit breaker is open (device path dark,
+        requests answered by the host fallback)."""
+        return self._breaker.state == "open"
 
     # -- model lifecycle ------------------------------------------------
     def swap(self, booster) -> bool:
@@ -159,7 +204,15 @@ class PredictionServer:
         with obs.span("serve.swap", cat="serve"):
             packed = pack_gbdt(gbdt, self.start_iteration,
                                self.num_iteration)
-            model = _Model(packed, gbdt)
+            host_trees = None
+            if self.host_fallback:
+                # the host trees of the SAME slice pack_gbdt flattened
+                # (shared clamping in packed.tree_slice) — the degrade
+                # path's answers must cover exactly the served trees
+                host_trees = list(tree_slice(
+                    gbdt.models, gbdt.num_model, self.start_iteration,
+                    self.num_iteration))
+            model = _Model(packed, gbdt, host_trees)
             with self._lock:
                 prev = self._model
                 self._model = model
@@ -213,18 +266,67 @@ class PredictionServer:
         return done
 
     # -- direct prediction ----------------------------------------------
+    def _score_batch(self, model: _Model, data: np.ndarray) -> np.ndarray:
+        """(K, rows) raw scores with graceful degradation: the device
+        kernel when the circuit breaker allows it, the host tree walk
+        when dispatch fails or the breaker is open.  Input errors (too
+        few features) raise immediately and never count against the
+        device."""
+        if data.shape[1] < model.packed.num_features:
+            # an input fault, not a device fault — fail the REQUEST
+            # without involving breaker or fallback (the host walk would
+            # read out-of-range feature indices)
+            raise LightGBMError(
+                f"query data has {data.shape[1]} features but the "
+                f"served model needs {model.packed.num_features}")
+        err: Optional[BaseException] = None
+        if self._breaker.allow():
+            try:
+                faults.check("serve.dispatch")
+                raw = predict_scores(model.packed, data,
+                                     min_bucket=self.min_bucket)
+            except Exception as e:   # noqa: BLE001 — degrade, not drop
+                err = e
+            else:
+                dark = self._breaker.record_success()
+                if dark is not None:
+                    obs.observe("serve.degraded_time", dark)
+                    obs.set_gauge("serve.degraded", 0)
+                    log_warning(f"serve: device path recovered after "
+                                f"{dark:.3f} s degraded")
+                return raw
+        if not self.host_fallback or model.host_trees is None:
+            if err is not None:
+                raise err
+            raise LightGBMError(
+                "serve: device path unavailable (circuit open) and "
+                "host fallback is disabled")
+        out = model.host_raw(data)
+        # the host walk answered, so the device exception above was a
+        # DEVICE fault (not an input fault): count it toward the breaker
+        if err is not None:
+            obs.inc("serve.device_failures")
+            if self._breaker.record_failure():
+                obs.set_gauge("serve.degraded", 1)
+                log_warning(f"serve: device dispatch failing ({err!r}); "
+                            f"circuit open — serving host fallback, "
+                            f"re-probing every "
+                            f"{self._breaker.reprobe_interval_s:g} s")
+        obs.inc("serve.fallback_requests")
+        return out
+
     def predict(self, data, raw_score: bool = False) -> np.ndarray:
         """Score a raw feature matrix against the current model — one
-        device dispatch, row-padded to a pow2 bucket.  Output matches
-        ``Booster.predict``: (rows,) for single-model ensembles,
-        (rows, num_model) for multiclass."""
+        device dispatch, row-padded to a pow2 bucket (host-walk
+        fallback under device failure, see :meth:`_score_batch`).
+        Output matches ``Booster.predict``: (rows,) for single-model
+        ensembles, (rows, num_model) for multiclass."""
         data = np.atleast_2d(np.asarray(data, np.float64))
         model = self._snapshot()
         with obs.span("serve.predict", cat="serve",
                       rows=int(data.shape[0])):
             obs.set_gauge("serve.batch_rows", int(data.shape[0]))
-            raw = predict_scores(model.packed, data,
-                                 min_bucket=self.min_bucket)
+            raw = self._score_batch(model, data)
             out = model.convert(raw, raw_score)
         obs.inc("serve.requests")
         obs.inc("serve.rows", int(data.shape[0]))
@@ -297,23 +399,40 @@ class PredictionServer:
         now = time.perf_counter()
         for _, _, _, t0 in batch:
             obs.observe("serve.queue_wait", now - t0)
-        try:
-            # one dispatch per raw_score flavor present in the batch
-            for flavor in sorted({rs for _, rs, _, _ in batch}):
-                group = [b for b in batch if b[1] == flavor]
+        # one dispatch per raw_score flavor present in the batch
+        for flavor in sorted({rs for _, rs, _, _ in batch}):
+            group = [b for b in batch if b[1] == flavor]
+            try:
                 data = np.concatenate([g[0] for g in group], axis=0) \
                     if len(group) > 1 else group[0][0]
                 out = self.predict(data, raw_score=flavor)
-                lo = 0
+            except Exception:   # noqa: BLE001 — isolate the poison
+                # fault isolation (docs/Robustness.md): one poisoned
+                # submit must fail only its OWN Future — retry each
+                # request alone so the good ones still resolve and the
+                # worker keeps draining later batches
+                obs.inc("serve.poisoned_batches")
                 for g in group:
-                    hi = lo + g[0].shape[0]
+                    try:
+                        res = self.predict(g[0], raw_score=flavor)
+                    except Exception as e:   # noqa: BLE001 — per-future
+                        if not g[2].done():
+                            g[2].set_exception(e)
+                    else:
+                        if not g[2].done():
+                            g[2].set_result(res)
+                continue
+            lo = 0
+            for g in group:
+                hi = lo + g[0].shape[0]
+                # a caller may have cancelled its Future (result
+                # timeout); resolving it would raise InvalidStateError
+                # and kill the worker thread
+                if not g[2].done():
                     g[2].set_result(out[lo:hi])
-                    lo = hi
-        except Exception as e:   # noqa: BLE001 — futures carry errors
-            for _, _, fut, _ in batch:
-                if not fut.done():
-                    fut.set_exception(e)
-            return
+                lo = hi
         done = time.perf_counter()
-        for _, _, _, t0 in batch:
-            obs.observe("serve.request_latency", done - t0)
+        for _, _, fut, t0 in batch:
+            if (fut.done() and not fut.cancelled()
+                    and fut.exception() is None):
+                obs.observe("serve.request_latency", done - t0)

@@ -149,6 +149,22 @@ class PackedEnsemble:
                 self.num_features)
 
 
+def tree_slice(models: List[Tree], num_model: int,
+               start_iteration: int = 0,
+               num_iteration: int = -1) -> List[Tree]:
+    """The SERVED tree slice ``models[start*K : end*K]`` (K =
+    ``num_model``) with the clamping every consumer must agree on —
+    shared by :func:`pack_ensemble` and the PredictionServer's
+    host-fallback trees, so the degrade path can never answer from a
+    different slice than the device kernel."""
+    k = max(int(num_model), 1)
+    total_iter = len(models) // k
+    start = max(0, min(int(start_iteration), total_iter))
+    end = total_iter if num_iteration <= 0 \
+        else min(start + int(num_iteration), total_iter)
+    return models[start * k:end * k]
+
+
 def pack_ensemble(models: List[Tree], num_model: int,
                   start_iteration: int = 0, num_iteration: int = -1,
                   num_features: Optional[int] = None) -> PackedEnsemble:
@@ -157,12 +173,8 @@ def pack_ensemble(models: List[Tree], num_model: int,
     alone — no dataset, no bin mappers — so file-loaded Boosters pack
     the same as freshly trained ones."""
     k = max(int(num_model), 1)
-    total_iter = len(models) // k
-    start = max(0, min(int(start_iteration), total_iter))
-    end = total_iter if num_iteration <= 0 \
-        else min(start + int(num_iteration), total_iter)
-    trees = models[start * k:end * k]
-    n_iter = max(end - start, 0)
+    trees = tree_slice(models, num_model, start_iteration, num_iteration)
+    n_iter = len(trees) // k
 
     i_pad = _pow2_at_least(max(n_iter, 1))
     t_pad = i_pad * k

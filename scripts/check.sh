@@ -39,14 +39,14 @@ step "jaxlint" python -m lightgbm_tpu.tools.jaxlint lightgbm_tpu \
     --baseline jaxlint_baseline.json
 
 # 2b. jaxlint with NO baseline over the modules that are debt-free
-#     today (stage-plan and the whole serve/ and pipeline/ subsystems
-#     ship with zero findings): unlike step 2 — where a new finding in
-#     a file with baselined siblings still fails but the file's debt
-#     can only ratchet down — this step pins an absolute zero-findings
-#     contract for the listed files
+#     today (stage-plan and the whole serve/, pipeline/ and robust/
+#     subsystems ship with zero findings): unlike step 2 — where a new
+#     finding in a file with baselined siblings still fails but the
+#     file's debt can only ratchet down — this step pins an absolute
+#     zero-findings contract for the listed files
 step "jaxlint (zero-debt modules)" python -m lightgbm_tpu.tools.jaxlint \
     lightgbm_tpu/ops/stage_plan.py lightgbm_tpu/serve \
-    lightgbm_tpu/pipeline --no-baseline
+    lightgbm_tpu/pipeline lightgbm_tpu/robust --no-baseline
 
 # 3. the telemetry schema validator validates itself
 step "validate_metrics --self-test" \
@@ -68,6 +68,12 @@ if [[ "${1:-}" != "--fast" ]]; then
     #     retrain pipeline — zero retraces after window 1, serving
     #     answers mid-train, swaps stay shape-stable (docs/Pipeline.md)
     step "pipeline smoke" python scripts/check_pipeline.py
+
+    # 5c. chaos smoke: a mid-stream kill (injected prep fault) resumes
+    #     from the per-window checkpoint to a byte-identical final
+    #     model, and serving under injected device death answers every
+    #     request host-exact then recovers (docs/Robustness.md)
+    step "fault smoke" python scripts/check_faults.py
 
     tier1() {
         rm -f /tmp/_t1.log

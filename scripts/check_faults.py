@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""CI chaos smoke for the fault-tolerance layer (docs/Robustness.md).
+
+Gates the two acceptance contracts of the robustness PR with the fault
+registry standing in for real hardware death:
+
+1. **Checkpoint/resume byte-identity** — a ``RetrainPipeline`` killed
+   mid-stream (injected ``pipeline.prep`` fault at window 2) resumes
+   from its per-window checkpoint and, under the deterministic config
+   (``pipeline_rebin=false``, ``window_policy=fresh``), produces a
+   final model BYTE-IDENTICAL to an uninterrupted reference run —
+   while skipping the completed windows' prep entirely.
+
+2. **Serve-through-device-death** — with a persistent injected
+   ``serve.dispatch`` fault, the ``PredictionServer`` answers 100% of
+   requests through the host fallback with outputs EXACTLY matching
+   the host ``Booster.predict`` walk, trips its circuit breaker
+   (``serve.degraded`` gauge = 1), and recovers to the device path
+   once the fault clears.
+
+Exit 0 on success, 1 with diagnostics on failure.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+          "min_data_in_leaf": 5, "verbosity": -1, "metric": "none",
+          "num_iterations": 6, "device_growth": "on"}
+WINDOWS = 4
+ROWS = 4000
+FEATURES = 8
+
+
+def gate_pipeline_resume(failures):
+    from lightgbm_tpu.pipeline import (PipelineError, PreppedWindow,
+                                       RetrainPipeline)
+    from lightgbm_tpu.robust import faults
+    from lightgbm_tpu.robust.checkpoint import load_pipeline_checkpoint
+
+    def make_prep(calls=None):
+        def prep(w):
+            if calls is not None:
+                calls.append(w)
+            rng = np.random.default_rng(300 + w)
+            x = rng.standard_normal((ROWS, FEATURES))
+            y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float64)
+            return PreppedWindow(label=y, dense=x)
+        return prep
+
+    kw = dict(window_policy="fresh", rebin_on_drift=False, serve=False)
+    ref = RetrainPipeline(PARAMS, **kw)
+    ref_final = ref.run(range(WINDOWS), make_prep())[-1] \
+        .booster.model_to_string()
+
+    ckpt = tempfile.mkdtemp(prefix="lgbm_faults_ckpt_")
+    faults.configure("pipeline.prep:at=2")
+    killed_at = None
+    try:
+        RetrainPipeline(PARAMS, checkpoint_dir=ckpt, **kw).run(
+            range(WINDOWS), make_prep())
+    except PipelineError as e:
+        killed_at = e.window
+    finally:
+        faults.clear()
+    if killed_at != 2:
+        failures.append(f"injected prep fault killed window "
+                        f"{killed_at!r}, expected 2")
+        return {}
+    cp = load_pipeline_checkpoint(ckpt)
+    if cp is None or cp.window != 1:
+        failures.append(f"checkpoint after the kill holds window "
+                        f"{getattr(cp, 'window', None)!r}, expected 1")
+        return {}
+
+    calls = []
+    resumed = RetrainPipeline.resume(ckpt, PARAMS, **kw)
+    res = resumed.run(range(WINDOWS), make_prep(calls))
+    final = res[-1].booster.model_to_string() if res else None
+    if calls != [2, 3]:
+        failures.append(f"resume re-prepped windows {calls}, "
+                        f"expected [2, 3]")
+    if final != ref_final:
+        failures.append("resumed final model is NOT byte-identical to "
+                        "the uninterrupted run")
+    return {"killed_at": killed_at, "resumed_windows": calls,
+            "byte_identical": final == ref_final}
+
+
+def gate_serve_degrade(failures):
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data.dataset import BinnedDataset
+    from lightgbm_tpu.robust import CircuitBreaker, faults
+    from lightgbm_tpu.serve.engine import PredictionServer
+
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((3000, FEATURES))
+    y = (x[:, 0] > 0).astype(np.float64)
+    cfg = Config(dict(PARAMS))
+    ds = BinnedDataset.construct_from_matrix(x, cfg)
+    ds.metadata.set_label(y)
+    bst = create_boosting(cfg)
+    bst.init_train(ds)
+    bst.train_chunked(PARAMS["num_iterations"], chunk=3)
+    bst._flush_pending()
+
+    srv = PredictionServer(bst, breaker=CircuitBreaker(
+        failure_threshold=2, reprobe_interval_s=0.05))
+    srv.warmup([256])
+    q = x[:256]
+    host_ref = np.asarray(bst.predict(q))   # host walk (small batch)
+
+    faults.configure("serve.dispatch:persist")
+    answered = exact = 0
+    requests = 20
+    try:
+        for _ in range(requests):
+            out = np.asarray(srv.predict(q))
+            answered += 1
+            if np.array_equal(out, host_ref):
+                exact += 1
+    except Exception as e:   # noqa: BLE001 — the gate records it
+        failures.append(f"request DROPPED under injected device death: "
+                        f"{e!r}")
+    finally:
+        faults.clear()
+    if answered != requests or exact != requests:
+        failures.append(f"device-death serving: {answered}/{requests} "
+                        f"answered, {exact}/{requests} host-exact")
+    degraded_gauge = obs.registry().gauge("serve.degraded")
+    if not srv.degraded or degraded_gauge != 1:
+        failures.append(f"breaker did not trip (degraded={srv.degraded}"
+                        f", gauge={degraded_gauge})")
+
+    time.sleep(0.06)                        # past the re-probe window
+    recovered = np.asarray(srv.predict(q))
+    if srv.degraded or obs.registry().gauge("serve.degraded") != 0:
+        failures.append("device path did not recover after the fault "
+                        "cleared")
+    if not np.allclose(recovered, host_ref, rtol=1e-4, atol=1e-6):
+        failures.append("post-recovery device answers diverged from "
+                        "host parity")
+    return {"requests": requests, "answered": answered,
+            "host_exact": exact,
+            "fallbacks": obs.registry().counter(
+                "serve.fallback_requests"),
+            "recovered": not srv.degraded}
+
+
+def main() -> int:
+    from lightgbm_tpu import obs
+    obs.configure(enabled=True)
+    failures = []
+    summary = {"pipeline": gate_pipeline_resume(failures),
+               "serve": gate_serve_degrade(failures)}
+    summary["obs_robust"] = obs.summary().get("robust")
+    print(json.dumps(summary))
+    if failures:
+        for f in failures:
+            print(f"FAULT SMOKE FAIL: {f}", file=sys.stderr)
+        return 1
+    print("fault smoke PASS: mid-stream kill resumed byte-identical, "
+          f"{summary['serve']['host_exact']}/"
+          f"{summary['serve']['requests']} requests served host-exact "
+          "through injected device death, device path recovered")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

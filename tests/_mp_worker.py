@@ -29,6 +29,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+if rank > 0:
+    # fail FAST with a clear "peer unreachable" error if the
+    # coordinator (rank 0) never comes up, instead of hanging the whole
+    # mesh inside the runtime's own much longer handshake
+    from lightgbm_tpu.parallel.network import wait_for_peer
+    wait_for_peer(sys.argv[1], attempts=60, timeout_s=2.0,
+                  base_delay_s=0.05)
 jax.distributed.initialize(coordinator_address=sys.argv[1],
                            num_processes=int(sys.argv[2]),
                            process_id=rank)

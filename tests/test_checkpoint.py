@@ -1,0 +1,104 @@
+"""CLI-level checkpoint/resume round trips (docs/Robustness.md).
+
+The satellite contract: ``snapshot_freq`` files written by the CLI load
+back and continue training to the SAME final model as an uninterrupted
+run — exercised through the real ``cli.main`` entry point (the
+``Application`` lifecycle), both for task=train snapshots and for
+task=pipeline window checkpoints.
+"""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import cli
+
+
+def _write_train_file(path, seed=0, n=1500, nf=6):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, nf))
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float64)
+    np.savetxt(path, np.column_stack([y, x]), delimiter="\t", fmt="%.6g")
+
+
+BASE = ["objective=binary", "num_leaves=15", "max_bin=63",
+        "min_data_in_leaf=5", "verbosity=-1", "metric=none",
+        "bagging_fraction=0.8", "bagging_freq=3", "feature_fraction=0.8"]
+
+
+@pytest.mark.timeout(120)
+def test_cli_snapshot_resume_matches_uninterrupted(tmp_path):
+    data = str(tmp_path / "train.tsv")
+    _write_train_file(data)
+
+    # uninterrupted 6-iteration reference
+    ref_model = str(tmp_path / "ref.txt")
+    assert cli.main([f"data={data}", f"output_model={ref_model}",
+                     "num_iterations=6", *BASE]) == 0
+
+    # "killed" run: 4 iterations with snapshots every 2
+    out_model = str(tmp_path / "model.txt")
+    assert cli.main([f"data={data}", f"output_model={out_model}",
+                     "num_iterations=4", "snapshot_freq=2",
+                     *BASE]) == 0
+    snap = f"{out_model}.snapshot_iter_4"
+    import os
+    assert os.path.exists(snap) and os.path.exists(snap + ".state.npz")
+
+    # resumed run: --resume picks up snapshot_iter_4, trains 2 more
+    assert cli.main([f"data={data}", f"output_model={out_model}",
+                     "num_iterations=6", "snapshot_freq=2", "--resume",
+                     *BASE]) == 0
+
+    ref = open(ref_model).read()
+    out = open(out_model).read()
+    # identical trees; only the knobs that DEFINE the interrupted run
+    # (paths, snapshot cadence, the resume flag) may differ
+    strip = lambda t: "\n".join(              # noqa: E731
+        line for line in t.splitlines()
+        if not line.startswith(("[output_model", "[snapshot_freq",
+                                "[resume_training")))
+    assert strip(out) == strip(ref)
+
+
+@pytest.mark.timeout(120)
+def test_cli_resume_without_snapshot_warns_and_trains(tmp_path):
+    data = str(tmp_path / "train.tsv")
+    _write_train_file(data, seed=1)
+    out_model = str(tmp_path / "model.txt")
+    assert cli.main([f"data={data}", f"output_model={out_model}",
+                     "num_iterations=2", "--resume", *BASE]) == 0
+    assert "tree" in open(out_model).read()
+
+
+@pytest.mark.timeout(180)
+def test_cli_pipeline_checkpoint_resume(tmp_path):
+    """task=pipeline with pipeline_checkpoint_dir commits every window;
+    a resumed run skips the committed windows and saves the same final
+    model as the straight-through run (fresh policy, rebin off)."""
+    data = str(tmp_path / "train.tsv")
+    _write_train_file(data, seed=2, n=4000)
+    args = [f"data={data}", "task=pipeline", "pipeline_windows=3",
+            "pipeline_rebin=false", "num_iterations=4", *BASE]
+
+    ref_model = str(tmp_path / "ref.txt")
+    assert cli.main(args + [f"output_model={ref_model}"]) == 0
+
+    # straight run WITH checkpointing, then resume over the same file:
+    # every window is already committed, so resume retrains nothing and
+    # re-saves the checkpointed final model
+    ckpt = str(tmp_path / "ckpt")
+    out_model = str(tmp_path / "model.txt")
+    assert cli.main(args + [f"output_model={out_model}",
+                            f"pipeline_checkpoint_dir={ckpt}"]) == 0
+    out_model2 = str(tmp_path / "model2.txt")
+    assert cli.main(args + [f"output_model={out_model2}",
+                            f"pipeline_checkpoint_dir={ckpt}",
+                            "--resume"]) == 0
+
+    strip = lambda t: "\n".join(              # noqa: E731
+        line for line in t.splitlines()
+        if not line.startswith(("[output_model", "[pipeline_checkpoint",
+                                "[resume_training", "[task")))
+    ref = strip(open(ref_model).read())
+    assert strip(open(out_model).read()) == ref
+    assert strip(open(out_model2).read()) == ref

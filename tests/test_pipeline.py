@@ -204,6 +204,78 @@ def test_bin_mapper_cache_save_load_roundtrip(tmp_path):
                                   ds_a.feature_penalty)
 
 
+def test_checkpoint_resume_byte_identical(tmp_path):
+    """Fault-tolerance contract (docs/Robustness.md): a pipeline killed
+    mid-stream by an injected prep fault resumes from its per-window
+    checkpoint, skips the completed windows' prep entirely, and — under
+    the deterministic config (rebin off, fresh policy) — finishes with
+    a final model BYTE-IDENTICAL to an uninterrupted run."""
+    from lightgbm_tpu.robust import faults
+
+    kw = dict(window_policy="fresh", rebin_on_drift=False, serve=False)
+    ref = RetrainPipeline(PARAMS, **kw)
+    ref_final = ref.run(range(4), _dense_prep(200))[-1] \
+        .booster.model_to_string()
+
+    ckpt = str(tmp_path / "ckpt")
+    faults.configure("pipeline.prep:at=2")
+    try:
+        pipe = RetrainPipeline(PARAMS, checkpoint_dir=ckpt, **kw)
+        with pytest.raises(PipelineError) as ei:
+            pipe.run(range(4), _dense_prep(200))
+        assert ei.value.window == 2
+        assert [r.window for r in ei.value.results] == [0, 1]
+    finally:
+        faults.clear()
+
+    calls = []
+    resumed = RetrainPipeline.resume(ckpt, PARAMS, **kw)
+    prep = _dense_prep(200)
+
+    def counting_prep(w):
+        calls.append(w)
+        return prep(w)
+
+    res = resumed.run(range(4), counting_prep)
+    assert [r.window for r in res] == [2, 3]
+    assert calls == [2, 3]                  # completed windows skipped
+    assert res[-1].booster.model_to_string() == ref_final
+    # the resumed run re-committed its own progress
+    from lightgbm_tpu.robust.checkpoint import load_pipeline_checkpoint
+    assert load_pipeline_checkpoint(ckpt).window == 3
+
+
+def test_checkpoint_resume_serves_last_good_model(tmp_path):
+    """Resume restores the previous model into serving (and the warm
+    policies) before any new window trains."""
+    ckpt = str(tmp_path / "ckpt")
+    kw = dict(window_policy="fresh", rebin_on_drift=False, serve=False)
+    pipe = RetrainPipeline(PARAMS, checkpoint_dir=ckpt, **kw)
+    first = pipe.run(range(2), _dense_prep(220, with_eval=True))
+
+    resumed = RetrainPipeline.resume(ckpt, PARAMS,
+                                     window_policy="fresh",
+                                     rebin_on_drift=False)
+    # the checkpointed window-1 model came back as _prev...
+    assert resumed._prev is not None
+    np.testing.assert_allclose(
+        resumed._prev.predict(_dense_window(221)[0][:64]),
+        first[-1].booster.predict(_dense_window(221)[0][:64]),
+        rtol=1e-12)
+    res = resumed.run(
+        range(4), _dense_prep(220, with_eval=True),
+        eval_fn=lambda pred, pw: {"n": len(np.asarray(pred))})
+    # ...so window 2 was scored against it BEFORE retraining (the
+    # test-then-train order survives the restart)
+    assert res[0].window == 2 and res[0].eval_metrics is not None
+    # and serving ends on the final window's model
+    x, _ = _dense_window(221)
+    np.testing.assert_allclose(
+        np.asarray(resumed.server.predict(x[:64])),
+        np.asarray(res[-1].booster.predict(x[:64])), rtol=1e-4,
+        atol=1e-6)
+
+
 def test_overlap_accounting():
     """Pipelined mode hides prep behind training (overlap ~1 when prep
     is cheap and training long); serial mode reports 0 overlap."""

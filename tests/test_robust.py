@@ -1,0 +1,563 @@
+"""The fault-tolerance layer (lightgbm_tpu/robust/, docs/Robustness.md).
+
+Contracts under test:
+
+* fault injection is DETERMINISTIC — count/at/after rules fire on exact
+  invocation indices, the probabilistic mode replays identically for
+  the same seed, and error flavors inherit the right builtin types so
+  real retry/except paths treat them like the failures they imitate;
+* ``with_retries`` retries only retryable errors, backs off with capped
+  deterministic jitter, and exhausts into a RetryError naming the
+  attempt count;
+* the circuit breaker trips on consecutive failures, blocks until the
+  re-probe window, and reports the dark-period duration on recovery;
+* atomic checkpoint writes never leave a torn file — a crash injected
+  between temp-write and rename preserves the previous content;
+* GBDT snapshot/resume continues a killed run BYTE-IDENTICALLY
+  (exact-score sidecar + host-learner RNG state);
+* the PredictionServer degrades to the host walk under injected device
+  death (zero dropped requests, byte-exact vs the host predict path)
+  and recovers once the fault clears;
+* one poisoned micro-batch submit fails only its own Future.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.boosting import create_boosting
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.data.dataset import BinnedDataset
+from lightgbm_tpu.robust import (CircuitBreaker, InjectedFault,
+                                 InjectedOSError, InjectedTimeout,
+                                 RetryError, RetryPolicy, backoff_delay,
+                                 checkpoint, faults, with_retries)
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """No test leaks an armed registry into the rest of the suite."""
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+# ---------------------------------------------------------------------------
+
+def _survives(site, n):
+    out = []
+    for _ in range(n):
+        try:
+            faults.check(site)
+            out.append(True)
+        except InjectedFault:
+            out.append(False)
+    return out
+
+
+def test_fault_count_and_at_rules():
+    faults.configure("grow.dispatch:n=2,serve.dispatch:at=3")
+    assert _survives("grow.dispatch", 4) == [False, False, True, True]
+    assert _survives("serve.dispatch", 5) == [True, True, True, False,
+                                              True]
+    assert faults.counts() == {"grow.dispatch": 2, "serve.dispatch": 1}
+    # unarmed sites never fire
+    assert _survives("net.send", 3) == [True, True, True]
+
+
+def test_fault_after_and_persist():
+    faults.configure("net.recv:after=2:n=1,io.read:at=1:persist")
+    assert _survives("net.recv", 5) == [True, True, False, True, True]
+    assert _survives("io.read", 5) == [True, False, False, False, False]
+
+
+def test_fault_probabilistic_mode_is_seed_deterministic():
+    faults.configure("io.write:p=0.5:seed=7")
+    pattern_a = _survives("io.write", 64)
+    faults.configure("io.write:p=0.5:seed=7")
+    assert _survives("io.write", 64) == pattern_a
+    faults.configure("io.write:p=0.5:seed=8")
+    assert _survives("io.write", 64) != pattern_a
+    assert 8 < sum(pattern_a) < 56      # actually probabilistic
+
+
+def test_fault_error_flavors_inherit_builtin_types():
+    faults.configure("net.connect:n=1:error=oserror,"
+                     "net.recv:n=1:error=timeout")
+    with pytest.raises(OSError) as ei:
+        faults.check("net.connect")
+    assert isinstance(ei.value, InjectedOSError)
+    with pytest.raises(TimeoutError) as ei:
+        faults.check("net.recv")
+    assert isinstance(ei.value, InjectedTimeout)
+
+
+def test_fault_env_and_config_arming(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "pipeline.prep:n=1")
+    faults.configure_from_env()
+    assert faults.active()
+    with pytest.raises(InjectedFault):
+        faults.check("pipeline.prep")
+    # config arming is idempotent for an unchanged spec: counters keep
+    # their progress across repeated init_train-style re-reads
+    cfg = Config({"fault_spec": "serve.dispatch:at=1", "verbosity": -1})
+    faults.configure_from_config(cfg)
+    faults.check("serve.dispatch")              # invocation 0 passes
+    faults.configure_from_config(cfg)           # must NOT reset to 0
+    with pytest.raises(InjectedFault):
+        faults.check("serve.dispatch")          # invocation 1 fires
+
+
+def test_fault_spec_rejects_garbage():
+    with pytest.raises(LightGBMError):
+        faults.parse_fault_spec("serve.dispatch:bogus")
+    with pytest.raises(LightGBMError):
+        faults.parse_fault_spec("serve.dispatch:error=nope")
+
+
+# ---------------------------------------------------------------------------
+# retries + breaker
+# ---------------------------------------------------------------------------
+
+def test_with_retries_recovers_and_backs_off():
+    calls, delays = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return 42
+
+    policy = RetryPolicy(max_attempts=5, base_delay_s=0.01,
+                         max_delay_s=0.5, retry_on=(OSError,))
+    assert with_retries(flaky, policy, site="t",
+                        sleep=delays.append) == 42
+    assert len(calls) == 3 and len(delays) == 2
+    # capped exponential with deterministic jitter: replay matches
+    assert delays == [backoff_delay(policy, 0, "t"),
+                      backoff_delay(policy, 1, "t")]
+    assert all(0 < d <= 0.5 for d in delays)
+
+
+def test_with_retries_exhausts_with_context():
+    def always():
+        raise OSError("down")
+
+    with pytest.raises(RetryError, match="failed after 3 attempts"):
+        with_retries(always, RetryPolicy(max_attempts=3,
+                                         base_delay_s=0.001,
+                                         retry_on=(OSError,)),
+                     site="net.connect", sleep=lambda d: None)
+
+
+def test_with_retries_propagates_non_retryable_immediately():
+    calls = []
+
+    def bad_shape():
+        calls.append(1)
+        raise ValueError("wrong shape")
+
+    with pytest.raises(ValueError):
+        with_retries(bad_shape,
+                     RetryPolicy(max_attempts=5, retry_on=(OSError,)),
+                     sleep=lambda d: None)
+    assert len(calls) == 1
+
+
+def test_circuit_breaker_lifecycle():
+    t = [0.0]
+    b = CircuitBreaker(failure_threshold=2, reprobe_interval_s=1.0,
+                       clock=lambda: t[0])
+    assert b.allow() and b.state == "closed"
+    assert b.record_failure() is False          # 1 failure: still closed
+    assert b.record_failure() is True           # trips
+    assert b.state == "open" and not b.allow()
+    t[0] = 0.5
+    assert not b.allow()                        # before the probe window
+    t[0] = 1.1
+    assert b.allow()                            # probe due
+    assert b.record_failure() is False          # failed probe: stay open
+    assert not b.allow()                        # window pushed out
+    t[0] = 2.5
+    assert b.allow()
+    dark = b.record_success()                   # recovery
+    assert dark == pytest.approx(2.5)           # total open duration
+    assert b.state == "closed" and b.allow()
+    assert b.record_success() is None           # steady-state success
+
+
+# ---------------------------------------------------------------------------
+# atomic checkpoints
+# ---------------------------------------------------------------------------
+
+def test_atomic_write_survives_injected_crash(tmp_path):
+    p = str(tmp_path / "f.txt")
+    checkpoint.atomic_write_text(p, "GENERATION-1")
+    faults.configure("io.write:n=1")
+    with pytest.raises(InjectedFault):
+        checkpoint.atomic_write_text(p, "GENERATION-2")
+    assert open(p).read() == "GENERATION-1"     # old content intact
+    faults.clear()
+    checkpoint.atomic_write_text(p, "GENERATION-2")
+    assert open(p).read() == "GENERATION-2"
+
+
+def test_pipeline_checkpoint_roundtrip(tmp_path):
+    d = str(tmp_path / "ckpt")
+    assert checkpoint.load_pipeline_checkpoint(d) is None
+    checkpoint.save_pipeline_checkpoint(
+        d, window=3, model_str="tree\nversion=v2\n",
+        meta={"policy": "fresh"})
+    cp = checkpoint.load_pipeline_checkpoint(d)
+    assert cp.window == 3
+    assert cp.model_string() == "tree\nversion=v2\n"
+    assert cp.bins_path is None
+    assert cp.meta["policy"] == "fresh"
+    assert checkpoint.has_pipeline_checkpoint(d)
+
+
+def test_latest_snapshot_requires_state_sidecar(tmp_path):
+    base = str(tmp_path / "m.txt")
+    for it in (2, 4):
+        checkpoint.atomic_write_text(f"{base}.snapshot_iter_{it}", "x")
+        checkpoint.save_train_state(
+            f"{base}.snapshot_iter_{it}.state.npz",
+            np.zeros((1, 4), np.float32), it)
+    # a bare model file without the sidecar cannot resume exactly
+    checkpoint.atomic_write_text(f"{base}.snapshot_iter_6", "x")
+    assert checkpoint.latest_snapshot(base).endswith("snapshot_iter_4")
+    assert checkpoint.latest_snapshot(str(tmp_path / "none.txt")) is None
+
+
+# ---------------------------------------------------------------------------
+# GBDT snapshot/resume (train_chunked snapshot_freq contract)
+# ---------------------------------------------------------------------------
+
+TRAIN_PARAMS = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+                "min_data_in_leaf": 5, "verbosity": -1, "metric": "none",
+                "bagging_fraction": 0.8, "bagging_freq": 3,
+                "feature_fraction": 0.8}
+
+
+def _train_data(seed=0, n=2000, nf=8):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, nf))
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float64)
+    return x, y
+
+
+def _booster(params, x, y):
+    cfg = Config(dict(params))
+    ds = BinnedDataset.construct_from_matrix(x, cfg)
+    ds.metadata.set_label(y)
+    bst = create_boosting(cfg)
+    bst.init_train(ds)
+    return bst
+
+
+@pytest.mark.parametrize("device_growth", ["off", "on"])
+def test_train_chunked_snapshot_resume_byte_identical(tmp_path,
+                                                      device_growth):
+    """A killed run resumed from its last snapshot finishes with a
+    model string byte-identical to the uninterrupted run — on the host
+    path (sequential feature_fraction RNG restored from the sidecar)
+    AND the device path (fold_in-keyed draws)."""
+    params = {**TRAIN_PARAMS, "device_growth": device_growth}
+    x, y = _train_data()
+    ref = _booster(params, x, y)
+    ref.train_chunked(6, chunk=4)
+    ref_str = ref.model_to_string()
+
+    base = str(tmp_path / "m.txt")
+    killed = _booster(params, x, y)
+    killed.train_chunked(4, chunk=4, snapshot_freq=2, snapshot_path=base)
+    snap = checkpoint.latest_snapshot(base)
+    assert snap is not None and snap.endswith("snapshot_iter_4")
+
+    resumed = _booster(params, x, y)
+    resumed.resume_from_checkpoint(snap)
+    assert resumed.iter == 4
+    resumed.train_chunked(2, chunk=4)
+    assert resumed.model_to_string() == ref_str
+
+
+def test_resume_rejects_mismatched_data(tmp_path):
+    x, y = _train_data()
+    bst = _booster(TRAIN_PARAMS, x, y)
+    base = str(tmp_path / "m.txt")
+    bst.train_chunked(2, chunk=2, snapshot_freq=2, snapshot_path=base)
+    other = _booster(TRAIN_PARAMS, *_train_data(seed=1, n=500))
+    with pytest.raises(LightGBMError, match="SAME training data"):
+        other.resume_from_checkpoint(checkpoint.latest_snapshot(base))
+
+
+# ---------------------------------------------------------------------------
+# serving degradation
+# ---------------------------------------------------------------------------
+
+def _served_booster():
+    x, y = _train_data(seed=3, n=1500, nf=6)
+    bst = _booster({"objective": "binary", "num_leaves": 15,
+                    "max_bin": 63, "verbosity": -1, "metric": "none"},
+                   x, y)
+    bst.train_chunked(5, chunk=5)
+    bst._flush_pending()
+    return bst, x
+
+
+def test_serve_degrades_to_host_and_recovers():
+    from lightgbm_tpu.serve.engine import PredictionServer
+    bst, x = _served_booster()
+    srv = PredictionServer(bst, breaker=CircuitBreaker(
+        failure_threshold=2, reprobe_interval_s=0.05))
+    srv.warmup([256])
+    q = x[:256]
+    host_ref = np.asarray(bst.predict(q))   # host walk (small batch)
+
+    faults.configure("serve.dispatch:persist")
+    outs = [np.asarray(srv.predict(q)) for _ in range(4)]
+    for out in outs:                        # zero dropped, EXACT parity
+        np.testing.assert_array_equal(out, host_ref)
+    assert srv.degraded
+
+    faults.clear()
+    time.sleep(0.06)                        # past the re-probe window
+    out = np.asarray(srv.predict(q))        # probe recovers the device
+    assert not srv.degraded
+    np.testing.assert_allclose(out, host_ref, rtol=1e-4, atol=1e-6)
+
+
+def test_serve_input_error_is_not_a_device_failure():
+    from lightgbm_tpu.serve.engine import PredictionServer
+    bst, x = _served_booster()
+    srv = PredictionServer(bst)
+    with pytest.raises(LightGBMError, match="features"):
+        srv.predict(np.zeros((4, 2)))       # too-narrow input
+    assert not srv.degraded                 # breaker untouched
+    assert np.isfinite(np.asarray(srv.predict(x[:8]))).all()
+
+
+def test_serve_microbatch_poison_isolated():
+    """One poisoned submit fails only its own Future; the worker keeps
+    draining later batches."""
+    from lightgbm_tpu.serve.engine import PredictionServer
+    bst, x = _served_booster()
+    srv = PredictionServer(bst, max_wait_ms=20.0)
+    srv.warmup([128])
+    with srv:
+        good1 = srv.submit(x[:8])
+        poison = srv.submit(np.zeros((4, 2)))   # wrong feature count
+        good2 = srv.submit(x[8:16])
+        assert np.isfinite(good1.result(timeout=10)).all()
+        assert isinstance(poison.exception(timeout=10), LightGBMError)
+        assert np.isfinite(good2.result(timeout=10)).all()
+        # the worker survived: a fresh submit still resolves
+        again = srv.submit(x[:8]).result(timeout=10)
+        np.testing.assert_allclose(again, good1.result(), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# device-dispatch retry path
+# ---------------------------------------------------------------------------
+
+def test_transient_dispatch_fault_is_retried_and_absorbed():
+    """An injected transient grow.dispatch failure is retried within
+    dispatch_retries and training completes with the same model."""
+    x, y = _train_data(seed=5)
+    params = {**TRAIN_PARAMS, "device_growth": "on",
+              "dispatch_retries": 2}
+    ref = _booster(params, x, y)
+    ref.train_chunked(4, chunk=2)
+    ref_str = ref.model_to_string()
+
+    faults.configure("grow.dispatch:at=1")
+    bst = _booster(params, x, y)
+    bst.train_chunked(4, chunk=2)
+    faults.clear()
+    assert bst.model_to_string() == ref_str
+    assert faults.counts() == {}            # cleared
+
+
+def test_persistent_dispatch_fault_exhausts_retries():
+    x, y = _train_data(seed=6, n=800)
+    params = {**TRAIN_PARAMS, "device_growth": "on",
+              "dispatch_retries": 1}
+    bst = _booster(params, x, y)
+    faults.configure("grow.dispatch:persist")
+    with pytest.raises(RetryError, match="grow.dispatch failed after "
+                                         "2 attempts"):
+        bst.train_chunked(2, chunk=2)
+
+
+# ---------------------------------------------------------------------------
+# network point-to-point helpers (parallel/network.py)
+# ---------------------------------------------------------------------------
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_connect_bounded_retries_against_never_listening_port():
+    """A peer that never listens exhausts the bounded retries with a
+    clear 'unreachable after N attempts' error instead of hanging the
+    worker mesh."""
+    from lightgbm_tpu.parallel.network import connect_with_retries
+    delays = []
+    t0 = time.perf_counter()
+    with pytest.raises(LightGBMError,
+                       match="unreachable after 3 attempts"):
+        connect_with_retries("127.0.0.1", _free_port(), attempts=3,
+                             timeout_s=0.5, base_delay_s=0.01,
+                             sleep=delays.append)
+    assert len(delays) == 2                 # attempts - 1 backoffs
+    assert time.perf_counter() - t0 < 5.0   # bounded, not hanging
+
+
+def test_wait_for_peer_validates_and_probes():
+    from lightgbm_tpu.parallel.network import wait_for_peer
+    with pytest.raises(LightGBMError, match="bad peer address"):
+        wait_for_peer("not-an-address", attempts=1)
+    with pytest.raises(LightGBMError, match="unreachable"):
+        wait_for_peer(f"127.0.0.1:{_free_port()}", attempts=2,
+                      timeout_s=0.2, base_delay_s=0.01,
+                      sleep=lambda d: None)
+
+
+def test_send_recv_roundtrip_and_timeout():
+    import socket
+
+    from lightgbm_tpu.parallel.network import (connect_with_retries,
+                                               recv_bytes, send_bytes)
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    host, port = srv.getsockname()
+    ready = threading.Event()
+
+    def peer():
+        conn, _ = srv.accept()
+        payload = recv_bytes(conn, timeout_s=5.0)
+        send_bytes(conn, payload[::-1], timeout_s=5.0)
+        ready.wait(5.0)                     # then go silent
+        conn.close()
+        srv.close()
+
+    t = threading.Thread(target=peer, daemon=True)
+    t.start()
+    sock = connect_with_retries(host, port, attempts=3, timeout_s=2.0)
+    send_bytes(sock, b"serialized mappers")
+    assert recv_bytes(sock) == b"sreppam dezilaires"
+    with pytest.raises(LightGBMError, match="network timeout"):
+        recv_bytes(sock, timeout_s=0.2)     # peer is silent now
+    ready.set()
+    sock.close()
+    t.join(timeout=5.0)
+
+
+def test_network_params_thread_through_config():
+    """network_retries / network_timeout are NOT inert: a Config passed
+    to the helpers governs attempts and the socket timeout."""
+    from lightgbm_tpu.parallel.network import connect_with_retries
+    cfg = Config({"network_retries": 2, "network_timeout": 0.25,
+                  "verbosity": -1})
+    delays = []
+    with pytest.raises(LightGBMError,
+                       match="unreachable after 2 attempts"):
+        connect_with_retries("127.0.0.1", _free_port(), config=cfg,
+                             base_delay_s=0.001, sleep=delays.append)
+    assert len(delays) == 1                 # attempts - 1
+    # explicit arguments win over the config
+    with pytest.raises(LightGBMError,
+                       match="unreachable after 4 attempts"):
+        connect_with_retries("127.0.0.1", _free_port(), attempts=4,
+                             config=cfg, base_delay_s=0.001,
+                             sleep=lambda d: None)
+
+
+def test_recv_rejects_corrupt_length_prefix():
+    """A garbage length prefix becomes a bounded protocol error with
+    peer context, never a giant allocation."""
+    import socket
+    import struct
+
+    from lightgbm_tpu.parallel.network import recv_bytes
+    a, b = socket.socketpair()
+    try:
+        a.sendall(struct.pack("<Q", 1 << 60))
+        with pytest.raises(LightGBMError, match="length prefix"):
+            recv_bytes(b, timeout_s=2.0)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_cancelled_future_does_not_kill_microbatch_worker():
+    """A caller cancelling its submitted Future (result timeout) must
+    not crash the worker thread when the batch later resolves."""
+    from lightgbm_tpu.serve.engine import PredictionServer
+    bst, x = _served_booster()
+    # a long batch window: the worker picks `doomed` up immediately,
+    # then waits for more items — the cancel lands deterministically
+    # BEFORE the batch resolves
+    srv = PredictionServer(bst, max_wait_ms=500.0)
+    srv.warmup([128])
+    with srv:
+        doomed = srv.submit(x[:4])
+        assert doomed.cancel()          # worker never marks it running
+        live = srv.submit(x[:8])
+        assert np.isfinite(live.result(timeout=10)).all()
+        # the worker survived the cancelled future in its batch
+        again = srv.submit(x[:8]).result(timeout=10)
+        np.testing.assert_allclose(again, live.result(), rtol=1e-6)
+
+
+def test_checkpoint_crash_between_payload_and_manifest(tmp_path):
+    """Versioned-payload contract: a crash AFTER window 2's model
+    landed but BEFORE the manifest rename leaves window 1's manifest
+    pointing at window 1's intact files."""
+    d = str(tmp_path / "ckpt")
+    checkpoint.save_pipeline_checkpoint(d, window=1, model_str="W1")
+    # io.write fires per atomic write: invocation 0 = window 2's model,
+    # invocation 1 would be the manifest — crash in between
+    faults.configure("io.write:at=1")
+    with pytest.raises(InjectedFault):
+        checkpoint.save_pipeline_checkpoint(d, window=2,
+                                            model_str="W2")
+    faults.clear()
+    cp = checkpoint.load_pipeline_checkpoint(d)
+    assert cp.window == 1 and cp.model_string() == "W1"
+    # clean retry commits window 2 and GCs window 1's payload
+    checkpoint.save_pipeline_checkpoint(d, window=2, model_str="W2")
+    cp = checkpoint.load_pipeline_checkpoint(d)
+    assert cp.window == 2 and cp.model_string() == "W2"
+    import os
+    assert not os.path.exists(os.path.join(d, "model.1.txt"))
+
+
+def test_injected_net_fault_is_retried():
+    """An oserror-flavored injected connect fault consumes retries like
+    a real refused connection (the retry_on contract)."""
+    import socket
+
+    from lightgbm_tpu.parallel.network import connect_with_retries
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    host, port = srv.getsockname()
+    faults.configure("net.connect:n=2:error=oserror")
+    sock = connect_with_retries(host, port, attempts=3, timeout_s=1.0,
+                                base_delay_s=0.001,
+                                sleep=lambda d: None)
+    assert faults.counts() == {"net.connect": 2}
+    sock.close()
+    srv.close()

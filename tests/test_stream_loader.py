@@ -156,3 +156,57 @@ def test_virtual_file_io(tmp_path):
             assert len(fh.readlines()) == 100
     finally:
         file_io._SCHEMES.pop("mem", None)   # don't leak into other tests
+
+
+def test_truncated_row_surfaces_with_file_and_line_context(tmp_path):
+    """A mid-stream parse error (the satellite contract): the consumer
+    gets a LightGBMError naming the FILE and the offending LINE, and
+    the double-buffered reader thread never hangs behind the full
+    queue (its bounded put notices the abandoned generator)."""
+    import threading
+    import time
+
+    from lightgbm_tpu.utils.log import LightGBMError
+
+    path = tmp_path / "trunc.libsvm"
+    with open(path, "w") as fh:
+        for i in range(300):
+            fh.write(f"{i % 2} 0:1.5 2:{i}.0 4:0.5\n")
+        fh.write("1 0:2.0 3:\n")          # truncated token, line 301
+        for i in range(100):
+            fh.write(f"{i % 2} 1:0.5\n")
+
+    cfg = Config({"objective": "binary", "verbosity": -1,
+                  "bin_construct_sample_cnt": 1000})
+    before = threading.active_count()
+    with pytest.raises(LightGBMError) as ei:
+        load_text_two_round(str(path), cfg)
+    msg = str(ei.value)
+    assert "trunc.libsvm" in msg and "301" in msg
+    # reader thread reaped: active threads return to the baseline
+    for _ in range(50):
+        if threading.active_count() <= before:
+            break
+        time.sleep(0.1)
+    assert threading.active_count() <= before
+
+
+def test_ragged_csv_row_context(tmp_path):
+    """CSV flavor: a ragged row (extra cells — e.g. a torn/concatenated
+    line from an interrupted writer) is located exactly.  Non-numeric
+    CELLS intentionally do not raise: ``_atof`` maps them to NaN, the
+    reference's lenient-parse behaviour."""
+    from lightgbm_tpu.utils.log import LightGBMError
+
+    path = tmp_path / "bad.csv"
+    with open(path, "w") as fh:
+        for i in range(200):
+            fh.write(f"{i % 2},{i}.5,3.25\n")
+        fh.write("1,2.0,3.0,4.0,5.0,6.0\n")   # ragged, line 201
+        fh.write("0,1.0,2.0\n")
+
+    cfg = Config({"objective": "binary", "verbosity": -1,
+                  "bin_construct_sample_cnt": 1000})
+    with pytest.raises(LightGBMError) as ei:
+        load_text_two_round(str(path), cfg)
+    assert "bad.csv" in str(ei.value) and "201" in str(ei.value)
