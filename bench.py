@@ -310,6 +310,9 @@ def run_higgs(args) -> dict:
         "iters": iters_run,
         "timed_iters": iters_timed,
         "timed_s": round(timed_s, 3),
+        # ms_per_tree is THE per-round comparison number (BENCH_r05:
+        # 469.75 on higgs/v5e); time_per_tree_ms kept as a legacy alias
+        "ms_per_tree": round(1000.0 * per_iter, 2),
         "time_per_tree_ms": round(1000.0 * per_iter, 2),
         "rows_per_sec": round(args.rows * iters_run / train_s, 0),
         # _synth suffix: quality on the synthetic planted-signal data —
@@ -446,6 +449,7 @@ def run_mslr(args) -> dict:
         "baseline_cpu_s": BASELINE_MSLR_S,
         "rows": rows,
         "iters": bst.num_iterations(),
+        "ms_per_tree": round(1000.0 * per_iter, 2),
         "time_per_tree_ms": round(1000.0 * per_iter, 2),
         # _synth suffix: NDCG on synthetic MSLR-shaped data; the ref
         # value is the reference's REAL-MSLR number, shown for context
@@ -548,6 +552,132 @@ def run_serve(args) -> dict:
 def _cc_counters() -> dict:
     from lightgbm_tpu import compile_cache
     return compile_cache.counters()
+
+
+def _kernel_route_counts(snapshot_before: dict) -> dict:
+    """grow.hist.* routing counter deltas since ``snapshot_before`` —
+    which histogram kernel (einsum/pallas x bf16/int8) actually served
+    the dispatches of one benchmark leg."""
+    from lightgbm_tpu import obs
+    if not obs.enabled():
+        return {}
+    now = obs.registry().snapshot()["counters"]
+    out = {}
+    for key, val in sorted(now.items()):
+        if key.startswith("grow.hist."):
+            delta = val - snapshot_before.get(key, 0)
+            if delta:
+                out[key.split("grow.hist.", 1)[1]] = delta
+    return out
+
+
+def run_quant(args) -> dict:
+    """Paired quantization benchmark: f32 / int8-einsum / int8-pallas
+    legs over ONE shared dataset in ONE process (warm compile cache,
+    identical bins), reporting ms_per_tree per leg plus the speedup
+    matrix — BENCH_r06's int8 claims as a single command producing a
+    single JSON line.
+
+    The pallas leg uses the VMEM kernel on TPU and interpret mode
+    elsewhere (CPU: plumbing/parity validation, not a perf number);
+    routing counters per leg record which kernel actually ran — the
+    kernel only serves full-width stages whose stat columns fit one
+    128-lane tile (wave_width * hist_cols <= 128), wider configs fall
+    back to the einsum and the JSON says so."""
+    import jax
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data.dataset import BinnedDataset
+
+    backend = jax.default_backend()
+    pallas_mode = "pallas" if backend == "tpu" else "interpret"
+    # paired legs need ONE stage plan: each leg has its own config
+    # digest (grad_quant_bits/hist_kernel differ), so wave_plan=auto's
+    # profile-on-first-use would let every leg install a different
+    # measured plan and the speedup matrix would conflate plan deltas
+    # with kernel deltas.  Default to the byte-stable fixed ladder;
+    # an explicit --wave-plan profiled still profiles per leg (then
+    # waves_per_tree in the JSON is the cross-check).
+    wave_plan = "fixed" if args.wave_plan == "auto" else args.wave_plan
+    base = {
+        "objective": "binary", "metric": "auc",
+        "num_leaves": args.num_leaves, "max_bin": args.max_bin,
+        "learning_rate": args.learning_rate,
+        "min_data_in_leaf": 20, "min_sum_hessian_in_leaf": 1e-3,
+        "bagging_fraction": 1.0, "feature_fraction": 1.0,
+        "verbosity": 0, "wave_plan": wave_plan,
+        "device_growth": {"device": "on", "host": "off",
+                          "auto": "auto"}[args.engine],
+    }
+    t0 = time.perf_counter()
+    if args.host_data:
+        x, y = synth_higgs(args.rows)
+        ds = BinnedDataset.construct_from_matrix(x, Config(base))
+    else:
+        x, y = synth_higgs_device(args.rows)
+        ds = BinnedDataset.construct_from_device_matrix(x, Config(base))
+        jax.block_until_ready(ds.binned)
+    ds.metadata.set_label(y)
+    t_prep = time.perf_counter() - t0
+
+    legs = [
+        ("f32", {"grad_quant_bits": 0}),
+        ("int8_einsum", {"grad_quant_bits": 8, "hist_kernel": "einsum"}),
+        ("int8_pallas", {"grad_quant_bits": 8,
+                         "hist_kernel": pallas_mode}),
+    ]
+    leg_out = {}
+    for name, extra in legs:
+        cfg = Config({**base, **extra})
+        bst = create_boosting(cfg)
+        before = obs.registry().snapshot()["counters"] \
+            if obs.enabled() else {}
+        t0 = time.perf_counter()
+        bst.init_train(ds)
+        t_init = time.perf_counter() - t0
+        chunk, warm, t_warm, timed_s, iters_timed = timed_train(
+            bst, args.iters, args.chunk)
+        per_iter = timed_s / max(iters_timed, 1)
+        grower = getattr(bst, "_grower", None)
+        leg_out[name] = {
+            "ms_per_tree": round(1000.0 * per_iter, 2),
+            "timed_s": round(timed_s, 3),
+            "timed_iters": iters_timed,
+            "warmup_compile_s": round(t_warm + t_init, 2),
+            "waves_per_tree": _waves_per_tree(bst),
+            "hist_kernel_tag": getattr(grower, "hist_kernel_tag", None),
+            "int_scan": bool(getattr(grower, "int_scan", False)),
+            "kernel_dispatches": _kernel_route_counts(before),
+        }
+
+    def _speedup(a, b):
+        return round(leg_out[a]["ms_per_tree"]
+                     / max(leg_out[b]["ms_per_tree"], 1e-9), 3)
+
+    return {
+        "metric": f"quant_suite_higgs_{args.rows}x28_{args.iters}iter"
+                  f"_ms_per_tree",
+        "value": leg_out["int8_pallas"]["ms_per_tree"],
+        "unit": "ms",
+        "rows": args.rows,
+        "iters": args.iters,
+        "num_leaves": args.num_leaves,
+        "max_bin": args.max_bin,
+        "fused_chunk": args.chunk,
+        "wave_plan": wave_plan,
+        "prep_s": round(t_prep, 2),
+        "legs": leg_out,
+        "speedup": {
+            "f32_vs_int8_einsum": _speedup("f32", "int8_einsum"),
+            "f32_vs_int8_pallas": _speedup("f32", "int8_pallas"),
+            "int8_einsum_vs_int8_pallas": _speedup("int8_einsum",
+                                                   "int8_pallas"),
+        },
+        "backend": backend,
+        "device": str(jax.devices()[0]),
+        "host_sentinel_ms": host_sentinel_ms(),
+    }
 
 
 def _coldstart_child(cmd, env, tag, expect_json=True):
@@ -746,7 +876,7 @@ def main() -> int:
                          "device on TPU")
     ap.add_argument("--suite",
                     choices=["all", "higgs", "mslr", "cache", "serve",
-                             "coldstart"],
+                             "coldstart", "quant"],
                     default=os.environ.get("BENCH_SUITE", "all"),
                     help="all = HIGGS headline + MSLR lambdarank "
                          "(both north stars, BASELINE.md); cache = the "
@@ -756,7 +886,11 @@ def main() -> int:
                          "p50/p95 + hot-swap retrace check; coldstart = "
                          "fresh-subprocess warmup_compile_s cold vs "
                          "persistent-compile-cache warm vs AOT-warmed "
-                         "(docs/ColdStart.md; gates warm >= 5x cold)")
+                         "(docs/ColdStart.md; gates warm >= 5x cold); "
+                         "quant = paired f32 / int8-einsum / int8-pallas "
+                         "legs over one shared dataset in one process, "
+                         "emitting ms_per_tree per leg + the speedup "
+                         "matrix + kernel routing counters (BENCH_r06)")
     ap.add_argument("--compile-cache-dir",
                     default=os.environ.get(
                         "LGBM_TPU_COMPILE_CACHE",
@@ -821,6 +955,8 @@ def main() -> int:
         args.suite = "cache"
     if args.suite == "coldstart":
         result = run_coldstart(args)
+    elif args.suite == "quant":
+        result = run_quant(args)
     elif args.suite == "cache":
         result = run_cache_admission(args)
     elif args.suite == "serve":

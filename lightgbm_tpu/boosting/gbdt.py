@@ -21,6 +21,7 @@ from ..config import Config
 from ..data.dataset import BinnedDataset
 from ..metrics import create_metrics
 from ..objectives import create_objective
+from ..ops import stage_plan as stage_plan_mod
 from ..ops.grow import DeviceGrower, device_growth_eligible
 from ..ops.traverse import add_tree_score, device_tree
 from ..robust import checkpoint as _checkpoint
@@ -308,13 +309,37 @@ class GBDT:
                                             row_bucketing=bucket_ok)
                 log_info("Using on-device tree growth (device_growth="
                          f"{mode})")
-                if str(getattr(cfg, "wave_plan", "auto")).lower() \
-                        == "profiled":
+                wp = str(getattr(cfg, "wave_plan", "auto")).lower()
+                if wp == "profiled":
                     # measure per-stage wave cost on the real binned
                     # matrix and install the derived stage plan; the
-                    # plan is cached per (shape, config) signature, so
-                    # later windows skip the measurement
+                    # plan is cached per (shape, config) signature (in
+                    # process + persisted beside the compile cache), so
+                    # later windows AND fresh processes skip the
+                    # measurement
                     self._grower.profile_stage_plan()
+                elif (wp == "auto"
+                      and self._grower.plan_source == "default"
+                      and self._grower.num_data
+                      >= stage_plan_mod.AUTO_PROFILE_MIN_ROWS
+                      and stage_plan_mod.store_dir() is not None):
+                    # profile-on-first-use at production scale: measure
+                    # once, install the derived plan only when it beats
+                    # the byte-stable legacy ladder by the 2% bar, and
+                    # persist the verdict either way (a persisted or
+                    # in-process plan sets plan_source != "default", so
+                    # this never re-measures).  Gated on an ACTIVE plan
+                    # store (= a persistent compile cache): probe
+                    # timings are noisy, so an unpersistable plan would
+                    # make same-config processes grow different trees —
+                    # breaking the checkpoint-resume byte-identity
+                    # contract (docs/Robustness.md) across process
+                    # restarts.  With the store active, the first
+                    # process persists its verdict at init and every
+                    # later process (including a crash-resume) adopts
+                    # it from disk instead of re-measuring.
+                    self._grower.profile_stage_plan(
+                        require_beat_legacy=True)
             elif mode == "on":
                 log_warning("device_growth=on requested but the "
                             "configuration is not eligible (monotone "

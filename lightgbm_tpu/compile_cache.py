@@ -127,6 +127,18 @@ def cache_dir() -> Optional[str]:
     return _STATE["dir"]
 
 
+def artifact_dir(name: str) -> Optional[str]:
+    """Directory for small library artifacts persisted beside the
+    compiled executables (e.g. ``stage_plans`` — profiled wave-stage
+    plans, ops/stage_plan.py) so they share the compile cache's
+    lifecycle: warm a deployment's cache dir and its profiled plans
+    travel with it.  Not created here; None when no cache is active."""
+    d = cache_dir()
+    if not d:
+        return None
+    return os.path.join(d, name)
+
+
 def configure(cache_dir: Optional[str], *,
               min_entry_bytes: Optional[int] = None,
               strict_keys: Optional[bool] = None,

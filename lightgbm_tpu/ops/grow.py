@@ -24,9 +24,13 @@ The TPU-native formulation is **dense**:
   bfloat16 matmul speed (counts are exact: 0/1 products, f32 accumulation);
   with ``grad_quant_bits=8`` the g/h columns are instead stochastically
   rounded to int8 against a per-tree global scale and the contraction runs
-  on the MXU's native int8->int32 path — histograms are dequantized ONCE
-  in f32 before split-gain evaluation, counts stay integer-exact, and leaf
-  values are REFIT from the full-precision gradients after growth;
+  on the MXU's native int8->int32 path — below ``INT32_SCAN_ROWS`` the
+  histograms then stay INTEGER end-to-end through the find-best prefix
+  sums and the per-leaf hist/total state (dequantized only at gain/leaf-
+  value math; counts, default-bin reconstruction and the parent-minus-
+  sibling subtraction are exact), larger datasets dequantize once in f32
+  before the scan, and leaf values are REFIT from the full-precision
+  gradients after growth either way;
 * growth is best-first like the reference (``serial_tree_learner.cpp:
   157-221``) but *wave-synchronized*: each wave evaluates the newest leaves
   (smaller sibling by direct histogram, larger by parent subtraction,
@@ -75,7 +79,8 @@ from .histogram import bucket_size, quantize_gh
 from .split import (F_DEFAULT_LEFT, F_FEATURE, F_GAIN, F_IS_CAT, F_LEFT_C,
                     F_LEFT_G, F_LEFT_H, F_LEFT_OUT, F_RIGHT_C, F_RIGHT_G,
                     F_RIGHT_H, F_RIGHT_OUT, F_THRESHOLD, FeatureMeta,
-                    NEG_INF, SplitHyper, find_best_split_impl)
+                    NEG_INF, SplitHyper, find_best_split_impl,
+                    find_best_split_quant)
 
 # rows per histogram chunk: large chunks amortize MXU ramp-up; the
 # per-chunk one-hot (CH, G, NB) bf16 stays fusable into the dot operand
@@ -97,6 +102,15 @@ REC_F_RIGHT_OUT = 8
 # path stripes its g/h columns at the same threshold: 127 * 2^24 stays
 # below the int32 accumulator limit per stripe.
 COUNT_SPLIT_ROWS = 1 << 24
+
+# int32 find-best scan eligibility (grad_quant_bits=8): every histogram
+# cell / prefix sum / subtraction intermediate is bounded by
+# |sum q| <= 127 * rows (|q| <= QUANT_MAX = 127 per row), so int32 is
+# EXACT up to floor((2^31 - 1) / 127) = 16,909,320 rows.  Above it the
+# quantized path dequantizes to f32 before the scan as in PR 4 (striped
+# stripe SUMS would wrap; see ROUND8_NOTES.md for the full analysis).
+# Module-level so tests can force the f32 fallback on small data.
+INT32_SCAN_ROWS = ((1 << 31) - 1) // 127
 
 
 def _ceil_to(x: int, m: int) -> int:
@@ -137,11 +151,14 @@ def feature_fraction_mask(seed: int, tree_idx, nf: int, k: int):
 
 
 def _combine_hist_cols(h, k: int):
-    """Collapse the K accumulated bf16-path stat columns (last axis) to
+    """Collapse the K accumulated stat columns (last axis) to
     [g, h, cnt].  K=3: passthrough.  K=4: striped counts summed.
     K=5: hi/lo g,h.  K=6: pairwise sums (hi/lo g,h + striped counts).
-    The int8 quantized path combines its own stripes in ``_wave_hist``
-    (f32 for g/h — an int32 stripe SUM can wrap — int32 for counts)."""
+    dtype-generic: the bf16 path passes f32 accumulators, the int32
+    quantized scan passes int32 (its K is 3 or 6; stripe sums stay
+    exact below INT32_SCAN_ROWS).  The quantized f32 FALLBACK combines
+    its own stripes in ``_wave_hist`` instead (f32 casts before the
+    sum — past the bound an int32 stripe SUM can wrap)."""
     import jax.numpy as _jnp
     if k == 5:
         return _jnp.stack([h[..., 0] + h[..., 1], h[..., 2] + h[..., 3],
@@ -238,6 +255,15 @@ class GrowerPrograms:
         # stochastic-rounded g/h so the contraction runs int8->int32.
         self.quant_bits, self.striped, self.hist_cols = _hist_layout(
             self.num_data, config)
+        # int32 end-to-end: below INT32_SCAN_ROWS the quantized
+        # histograms stay integer through the find-best prefix sums
+        # (split.find_best_split_quant) and the per-leaf hist/total
+        # state, dequantizing only at gain/leaf-value math; counts and
+        # the parent-minus-sibling subtraction become exact.  The bound
+        # is on n_pad: the stage-profiling probes weight every padded
+        # row, and pad rows are zero-masked in production anyway.
+        self.int_scan = bool(self.quant_bits) \
+            and self.n_pad <= INT32_SCAN_ROWS
         # Wave cost measured on the chip (scripts/ubench_hist.py,
         # 10.5M rows): ~15.9 ms fixed (the one-hot operand generation
         # over all N, width-independent) + ~0.203 ms per stat column —
@@ -255,16 +281,25 @@ class GrowerPrograms:
                            for w, c in plan]
         self.plan_source = plan_source
         # hist_kernel: "auto"/"einsum" use the XLA einsum formulation —
-        # the best measured (both Pallas kernels lost to it, see
-        # ops/hist_pallas.py); "pallas" opts into the VMEM kernel on
-        # hardware, "interpret" runs it in interpreter mode (CPU tests).
-        # The int8 quantized path always uses the einsum (the Pallas
-        # kernel is bf16-only).
+        # the best measured for bf16 (both Pallas kernels lost to it,
+        # see ops/hist_pallas.py); "pallas" opts into the VMEM kernel
+        # on hardware, "interpret" runs it in interpreter mode (CPU
+        # tests).  Both the bf16 and the int8 quantized stat columns
+        # route through the same gate; the kernel accumulates
+        # int8->int32 on the MXU for grad_quant_bits=8 and is
+        # byte-identical to the int8 einsum (integer accumulation).
         mode = str(getattr(config, "hist_kernel", "auto")
                    or "auto").lower()
         self.pallas_interpret = mode == "interpret"
-        self.use_pallas = (mode in ("pallas", "interpret")
-                           and not self.quant_bits)
+        self.use_pallas = mode in ("pallas", "interpret")
+        # routing attribution for BENCH digests: which kernel serves
+        # the full-width stage (narrow stages always stay on the
+        # einsum; multi-tile waves fall back to it too)
+        kern = "pallas" if (self.use_pallas
+                            and self.wave_width * self.hist_cols <= 128) \
+            else "einsum"
+        self.hist_kernel_tag = \
+            f"{kern}_{'int8' if self.quant_bits else 'bf16'}"
         # recompile tracking: these TrackedJit wrappers are shared by
         # every grower that adopts this programs object, so in the
         # retrain-every-window pattern a warm window re-dispatches into
@@ -313,8 +348,10 @@ class GrowerPrograms:
         """(n_pad,) leaf ids, (n_pad, K) stat columns (bf16 — K=3:
         [g,h,1]; K=5: [g_hi,g_lo,h_hi,h_lo,1] — or int8 under
         grad_quant_bits), (W,) pending leaf ids (-1 = empty slot)
-        -> (W, S, 3) f32.  ``scales`` is the (2,) [scale_g, scale_h]
-        dequantization vector (quantized mode only).
+        -> (W, S, 3) f32, or int32 in quantized units when
+        ``self.int_scan`` (the find-best scan then stays integer).
+        ``scales`` is the (2,) [scale_g, scale_h] dequantization vector
+        (quantized f32-fallback mode only).
 
         The one-hot must stay a bare iota-compare so XLA fuses its
         generation into the dot operand (a multi-hot built as
@@ -324,54 +361,64 @@ class GrowerPrograms:
         g, nb = self.num_groups, self.nb
         w = pending.shape[0]
         k = self.hist_cols
+        quant = bool(self.quant_bits)
         if self.use_pallas and w == self.wave_width and w * k <= 128:
             # the VMEM kernel packs all stat columns into one 128-lane
             # tile; wider (multi-tile) waves stay on the einsum
             # full-width stage: MXU cost is tile-bound regardless of W,
             # so the VMEM-resident kernel wins; narrow early stages stay
-            # on the einsum (XLA lowers small-N contractions cheaper)
+            # on the einsum (XLA lowers small-N contractions cheaper).
+            # int8 stat columns take the kernel's int8->int32 variant —
+            # integer accumulation, so byte-identical to the einsum.
             from .hist_pallas import wave_hist_pallas
             out = wave_hist_pallas(binned, leaf_id, ghk, pending,
                                    g=g, nb=nb, k=k, w=w,
                                    interpret=self.pallas_interpret)
-            h = out.reshape(g, nb, k, w).transpose(3, 0, 1, 2) \
-                .reshape(w, self.num_slots, k)
-            return _combine_hist_cols(h, k)
-        ch = _CHUNK
-        n_chunks = self.n_pad // ch
-        binned_c = binned.reshape(n_chunks, ch, g)
-        leaf_c = leaf_id.reshape(n_chunks, ch)
-        ghk_c = ghk.reshape(n_chunks, ch, k)
-        quant = bool(self.quant_bits)
-        mdtype = jnp.int8 if quant else jnp.bfloat16
-        adtype = jnp.int32 if quant else jnp.float32
+            acc = out.reshape(g, nb, k, w).transpose(0, 1, 3, 2)
+        else:
+            ch = _CHUNK
+            n_chunks = self.n_pad // ch
+            binned_c = binned.reshape(n_chunks, ch, g)
+            leaf_c = leaf_id.reshape(n_chunks, ch)
+            ghk_c = ghk.reshape(n_chunks, ch, k)
+            mdtype = jnp.int8 if quant else jnp.bfloat16
+            adtype = jnp.int32 if quant else jnp.float32
 
-        def body(acc, xs):
-            b, l, gk = xs
-            lm = (l[:, None] == pending[None, :]).astype(mdtype)
-            bmat = (lm[:, :, None] * gk[:, None, :]).reshape(ch, w * k)
-            # bin tiling: a one-hot wider than 64 breaks XLA's
-            # operand fusion (max_bin=255 measured 10x the max_bin=63
-            # wave, not the expected 4x) — strips of 64 keep each
-            # einsum in the known-fused regime; out-of-strip bins make
-            # all-zero one-hot rows, so the concat reassembles exactly
-            bi = b.astype(jnp.int32)
-            outs = []
-            for off in range(0, nb, 64):
-                oh = jax.nn.one_hot(bi - off, min(nb, 64),
-                                    dtype=mdtype)               # (CH,G,64)
-                outs.append(jnp.einsum("cgn,cb->gnb", oh, bmat,
-                                       preferred_element_type=adtype))
-            out = outs[0] if len(outs) == 1 \
-                else jnp.concatenate(outs, axis=1)
-            return acc + out, None
+            def body(acc, xs):
+                b, l, gk = xs
+                lm = (l[:, None] == pending[None, :]).astype(mdtype)
+                bmat = (lm[:, :, None] * gk[:, None, :]).reshape(ch,
+                                                                 w * k)
+                # bin tiling: a one-hot wider than 64 breaks XLA's
+                # operand fusion (max_bin=255 measured 10x the
+                # max_bin=63 wave, not the expected 4x) — strips of 64
+                # keep each einsum in the known-fused regime; out-of-
+                # strip bins make all-zero one-hot rows, so the concat
+                # reassembles exactly
+                bi = b.astype(jnp.int32)
+                outs = []
+                for off in range(0, nb, 64):
+                    oh = jax.nn.one_hot(bi - off, min(nb, 64),
+                                        dtype=mdtype)           # (CH,G,64)
+                    outs.append(jnp.einsum("cgn,cb->gnb", oh, bmat,
+                                           preferred_element_type=adtype))
+                out = outs[0] if len(outs) == 1 \
+                    else jnp.concatenate(outs, axis=1)
+                return acc + out, None
 
-        acc0 = jnp.zeros((g, nb, w * k), adtype)
-        acc, _ = jax.lax.scan(body, acc0, (binned_c, leaf_c, ghk_c))
-        acc = acc.reshape(g, nb, w, k)
-        if quant:
-            # dequantize ONCE per histogram: integer bin sums scaled
-            # back to f32 before any gain math.  Striped g/h stripes are
+            acc0 = jnp.zeros((g, nb, w * k), adtype)
+            acc, _ = jax.lax.scan(body, acc0, (binned_c, leaf_c, ghk_c))
+            acc = acc.reshape(g, nb, w, k)
+        if quant and self.int_scan:
+            # int32 end-to-end: the histogram stays in quantized units
+            # for the find-best scan (split.find_best_split_quant
+            # dequantizes at gain math).  _combine_hist_cols is dtype-
+            # generic — striped stripes (k=6) sum in int32, exact below
+            # INT32_SCAN_ROWS, which gates int_scan; k=3 passes through.
+            hist = _combine_hist_cols(acc, k)
+        elif quant:
+            # f32 fallback past INT32_SCAN_ROWS: dequantize ONCE per
+            # histogram before any gain math.  Striped g/h stripes are
             # cast to f32 BEFORE summing — each stripe is int32-exact
             # (< 127 * 2^24), but their int32 SUM can wrap for a bin
             # holding > 2^31/127 rows (hess == 1.0 quantizes to 127
@@ -439,10 +486,16 @@ class GrowerPrograms:
         clipped = jnp.clip(out, -hp.max_delta_step, hp.max_delta_step)
         return jnp.where(hp.max_delta_step <= 0.0, out, clipped)
 
-    def _splittable(self, total, depth):
+    def _splittable(self, total, depth, hess_scale=None):
+        """``hess_scale`` dequantizes the hessian column when ``total``
+        carries int32 quantized units (the int32 scan); counts compare
+        directly in either representation."""
         cfg = self.config
+        hess = total[..., 1]
+        if hess_scale is not None:
+            hess = hess.astype(jnp.float32) * hess_scale
         ok = (total[..., 2] > 2 * cfg.min_data_in_leaf) \
-            & (total[..., 1] > 2 * cfg.min_sum_hessian_in_leaf)
+            & (hess > 2 * cfg.min_sum_hessian_in_leaf)
         if cfg.max_depth > 0:
             ok = ok & (depth < cfg.max_depth)
         return ok
@@ -492,18 +545,28 @@ class GrowerPrograms:
             one_f = one_f * jnp.pad(row_mask, (0, npad_rows))
         gh5, qscales = self._stat_columns(grad, hess, one_f, tree_idx)
         wave_scales = qscales if self.quant_bits else None
+        # int32 scan (grad_quant_bits=8 below INT32_SCAN_ROWS): the
+        # per-leaf hist/total state stays in quantized integer units —
+        # the parent-minus-sibling subtraction, default-bin
+        # reconstruction and every prefix sum are then EXACT — and the
+        # packed f32 records keep real units (pack_best dequantizes)
+        int_scan = self.int_scan
+        hdtype = jnp.int32 if int_scan else jnp.float32
 
         leaf_id0 = jnp.where(jnp.arange(n, dtype=jnp.int32) < num_valid,
                              0, -1)
 
         class _S(NamedTuple):
             leaf_id: jnp.ndarray        # (n,) i32
-            hist: jnp.ndarray           # (L+1, S, 3) f32
-            total: jnp.ndarray          # (L+1, 3) f32
+            hist: jnp.ndarray           # (L+1, S, 3) f32 (i32: int scan)
+            total: jnp.ndarray          # (L+1, 3) f32 (i32: int scan)
             value: jnp.ndarray          # (L+1,) f32
             depth: jnp.ndarray          # (L+1,) i32
             best: jnp.ndarray           # (L+1, 13) f32, gain NEG_INF if none
             bestc: jnp.ndarray          # (L+1, 256) bool cat membership
+            bestl: jnp.ndarray          # (L+1, 3) i32 exact left totals
+            #                             of the best split (int scan;
+            #                             (1, 3) dummy otherwise)
             nl: jnp.ndarray             # i32 leaves so far
             waves: jnp.ndarray          # i32 wave count (profiling)
             done: jnp.ndarray           # bool
@@ -521,12 +584,14 @@ class GrowerPrograms:
         W0 = min(4, W) if (4 < W and 8 < L) else W   # first stage width
         init = _S(
             leaf_id=leaf_id0,
-            hist=jnp.zeros((L + 1, S, 3), jnp.float32),
-            total=jnp.zeros((L + 1, 3), jnp.float32),
+            hist=jnp.zeros((L + 1, S, 3), hdtype),
+            total=jnp.zeros((L + 1, 3), hdtype),
             value=jnp.zeros((L + 1,), jnp.float32),
             depth=jnp.zeros((L + 1,), jnp.int32),
             best=neg,
             bestc=jnp.zeros((L + 1, 256), bool),
+            bestl=jnp.zeros((L + 1, 3) if int_scan else (1, 3),
+                            jnp.int32),
             nl=jnp.asarray(1, jnp.int32),
             waves=jnp.asarray(0, jnp.int32),
             done=jnp.asarray(False),
@@ -543,17 +608,29 @@ class GrowerPrograms:
         has_cat = self.has_cat
         find_one = functools.partial(find_best_split_impl, meta=meta,
                                      hp=hyper, has_cat=has_cat)
+        find_q = functools.partial(find_best_split_quant, meta=meta,
+                                   hp=hyper, has_cat=has_cat)
 
         def evaluate(hists, totals, ids, depths, feature_mask):
             """vmapped find-best over fresh leaves; gated by splittability.
-            Returns (packed (B,13), cat_member (B,256) bool)."""
+            Returns (packed (B,13), cat_member (B,256) bool, left_int
+            (B,3) i32 exact quantized-unit left totals — None unless the
+            int32 scan is active)."""
             cons = jnp.asarray([-jnp.inf, jnp.inf], jnp.float32)
-            packed, catm = jax.vmap(
-                lambda h, t: find_one(h, t, cons, feature_mask))(hists,
-                                                                 totals)
-            ok = self._splittable(totals, depths) & (ids >= 0)
+            if int_scan:
+                packed, catm, lint = jax.vmap(
+                    lambda h, t: find_q(h, t, qscales, cons,
+                                        feature_mask))(hists, totals)
+                ok = self._splittable(totals, depths,
+                                      hess_scale=qscales[1]) & (ids >= 0)
+            else:
+                packed, catm = jax.vmap(
+                    lambda h, t: find_one(h, t, cons, feature_mask))(
+                        hists, totals)
+                lint = None
+                ok = self._splittable(totals, depths) & (ids >= 0)
             gain = jnp.where(ok, packed[:, F_GAIN], NEG_INF)
-            return packed.at[:, F_GAIN].set(gain), catm
+            return packed.at[:, F_GAIN].set(gain), catm, lint
 
         def make_wave(Ws: int):
           def wave(st: _S) -> _S:
@@ -579,11 +656,16 @@ class GrowerPrograms:
                 jnp.where(sm_ok[:, None, None], fresh, st.hist[sm_idx]))
             hist = hist.at[lg_idx].set(
                 jnp.where(lg_ok[:, None, None], large, hist[lg_idx]))
-            # root value (stump case + records)
+            # root value (stump case + records); int scan: the root
+            # totals are quantized units, dequantize for the output
+            if int_scan:
+                rt_g = total[0, 0].astype(jnp.float32) * qscales[0]
+                rt_h = total[0, 1].astype(jnp.float32) * qscales[1]
+            else:
+                rt_g, rt_h = total[0, 0], total[0, 1]
             value = jnp.where(
                 root_wave,
-                st.value.at[0].set(self._leaf_output(total[0, 0],
-                                                     total[0, 1], hyper)),
+                st.value.at[0].set(self._leaf_output(rt_g, rt_h, hyper)),
                 st.value)
 
             # 3. find-best for the new leaves (both siblings); reuse the
@@ -592,13 +674,18 @@ class GrowerPrograms:
                                    jnp.where(lg_ok, st.p_large, -1)])
             hists2 = jnp.concatenate([fresh, large])
             idc = jnp.clip(ids, 0, L - 1)
-            packed, catm = evaluate(hists2, total[idc], ids,
-                                    st.depth[idc], feature_mask)
+            packed, catm, lint = evaluate(hists2, total[idc], ids,
+                                          st.depth[idc], feature_mask)
             safe = jnp.where(ids >= 0, ids, L)
             best = st.best.at[safe].set(
                 jnp.where((ids >= 0)[:, None], packed, st.best[safe]))
             bestc = st.bestc.at[safe].set(
                 jnp.where((ids >= 0)[:, None], catm, st.bestc[safe]))
+            if int_scan:
+                bestl = st.bestl.at[safe].set(
+                    jnp.where((ids >= 0)[:, None], lint, st.bestl[safe]))
+            else:
+                bestl = st.bestl
 
             # 4. select up to Ws best-gain splits within budget
             gains = best[:L, F_GAIN]
@@ -677,8 +764,19 @@ class GrowerPrograms:
             # bookkeeping (vectorized scatters into the L-padded arrays)
             safe_l = jnp.where(sel, lsel, L)
             safe_r = jnp.where(sel, r_ids, L)
-            lsum = vecs[:, jnp.asarray([F_LEFT_G, F_LEFT_H, F_LEFT_C])]
-            rsum = vecs[:, jnp.asarray([F_RIGHT_G, F_RIGHT_H, F_RIGHT_C])]
+            if int_scan:
+                # exact integer child totals: the winner's left sums
+                # come straight from the scan (bestl) and the right
+                # child is the parent total minus them — both in
+                # quantized units, both exact (read the parent BEFORE
+                # the scatter overwrites its slot)
+                lsum = bestl[jnp.clip(lsel, 0, L)]
+                rsum = total[jnp.clip(lsel, 0, L)] - lsum
+            else:
+                lsum = vecs[:, jnp.asarray([F_LEFT_G, F_LEFT_H,
+                                            F_LEFT_C])]
+                rsum = vecs[:, jnp.asarray([F_RIGHT_G, F_RIGHT_H,
+                                            F_RIGHT_C])]
             total = total.at[safe_l].set(
                 jnp.where(sel[:, None], lsum, total[safe_l]))
             total = total.at[safe_r].set(
@@ -714,14 +812,18 @@ class GrowerPrograms:
                     jnp.where(sel[:, None], cmw, st.rec_c[ridx]))
             else:
                 rec_c = st.rec_c
-            # pending for the next wave
-            small_left = vecs[:, F_LEFT_C] <= vecs[:, F_RIGHT_C]
+            # pending for the next wave (int scan: exact integer counts
+            # decide the smaller sibling — f32 counts round past 2^24)
+            if int_scan:
+                small_left = lsum[:, 2] <= rsum[:, 2]
+            else:
+                small_left = vecs[:, F_LEFT_C] <= vecs[:, F_RIGHT_C]
             pp = jnp.where(sel, lsel, -1)
             ps = jnp.where(sel, jnp.where(small_left, lsel, r_ids), -1)
             pl = jnp.where(sel, jnp.where(small_left, r_ids, lsel), -1)
 
             return _S(leaf_id=leaf_id, hist=hist, total=total, value=value,
-                      depth=depth, best=best, bestc=bestc,
+                      depth=depth, best=best, bestc=bestc, bestl=bestl,
                       nl=st.nl + napply,
                       waves=st.waves + 1, done=napply == 0,
                       rec_i=rec_i, rec_f=rec_f, rec_c=rec_c,
@@ -951,7 +1053,8 @@ def programs_signature(num_data: int, num_groups: int, nb: int,
     full config (hashed — over-keying only costs cache hits, never
     correctness)."""
     return (num_data, num_groups, nb, num_features, bool(has_cat),
-            _CHUNK, COUNT_SPLIT_ROWS, _config_digest(config))
+            _CHUNK, COUNT_SPLIT_ROWS, INT32_SCAN_ROWS,
+            _config_digest(config))
 
 
 def get_grower_programs(num_data: int, num_groups: int, nb: int,
@@ -969,6 +1072,16 @@ def get_grower_programs(num_data: int, num_groups: int, nb: int,
         cached = stage_plan_mod.cached_plan(base)
         if cached is not None:
             plan, plan_source = cached, "profiled"
+        else:
+            # cross-process: a plan profiled by an earlier process is
+            # persisted beside the compile cache — adopt it instead of
+            # re-measuring (ROADMAP 1c; corrupt/mismatched files fall
+            # back to the legacy plan below)
+            persisted = stage_plan_mod.load_plan(base)
+            if persisted is not None:
+                plan, plan_source = persisted, "persisted"
+                stage_plan_mod.cache_plan(base, persisted, persist=False)
+                obs.inc("grow.plan_persisted_loads")
     if plan is None:
         plan = default_stage_plan(num_data, config)
     pd = stage_plan_mod.plan_digest(plan)
@@ -983,11 +1096,11 @@ def get_grower_programs(num_data: int, num_groups: int, nb: int,
         progs = _PROGRAM_CACHE.get(key)
         if progs is not None:
             _PROGRAM_CACHE.move_to_end(key)
-            if plan_source == "profiled":
+            if plan_source in ("profiled", "persisted"):
                 # the profiled plan can coincide with the plan a cached
                 # entry was built under (same digest => same key); the
                 # plan is now measurement-confirmed either way
-                progs.plan_source = "profiled"
+                progs.plan_source = plan_source
             obs.inc("grow.cache_hits")
             return progs
         obs.inc("grow.cache_misses")
@@ -1127,6 +1240,9 @@ class DeviceGrower:
         if lr is None:
             lr = self.lr
         obs.inc("grow.dispatches")
+        # routing attribution: which kernel serves this dispatch's
+        # full-width histogram stage (BENCH digests read these)
+        obs.inc(f"grow.hist.{self.programs.hist_kernel_tag}")
         ti = jnp.asarray(tree_idx, jnp.int32)
         if self._row_pad:
             # bucket pad: the program's row dim is the pow2 bucket; the
@@ -1174,7 +1290,10 @@ class DeviceGrower:
                 return jnp.pad(a, [(0, row_pad)] + [(0, 0)] * (a.ndim - 1))
             return a
 
+        kernel_tag = self.programs.hist_kernel_tag
+
         def run(binned, binned_t, score, lr, gargs, it0, grad_fn):
+            obs.inc(f"grow.hist.{kernel_tag}")
             if row_pad:
                 score = jnp.pad(score, (0, row_pad))
                 gargs = jax.tree_util.tree_map(_pad_rows, gargs)
@@ -1187,15 +1306,24 @@ class DeviceGrower:
         return run
 
     # ------------------------------------------------------------------
-    def profile_stage_plan(self, reps: int = 3, install: bool = True):
+    def profile_stage_plan(self, reps: int = 3, install: bool = True,
+                           require_beat_legacy: bool = False):
         """Time the wave histogram at every candidate stage width on the
         REAL binned matrix, record the per-stage timings through the obs
         layer (``grow.stage.w<W>`` spans + gauges), fit the
         fixed-vs-per-column cost model and derive the cheapest stage
         plan (ops/stage_plan.py).  ``install=True`` caches the plan
-        under this grower's (shape, config) signature — later growers
-        with the same signature pick it up automatically — and swaps
-        this grower onto programs built for the new plan.
+        under this grower's (shape, config) signature — in process AND
+        persisted beside the compile cache, so later growers (and fresh
+        processes) pick it up without re-measuring — and swaps this
+        grower onto programs built for the new plan.
+
+        ``require_beat_legacy`` (the ``wave_plan=auto``
+        profile-on-first-use path) keeps the byte-stable legacy ladder
+        unless the derived plan's modeled cost beats it by the 2%
+        ``stage_plan.MIN_IMPROVEMENT`` bar — the legacy-confirming
+        result is still cached/persisted, so the measurement happens
+        once per signature either way.
 
         Returns ``{"stage_ms", "fixed_ms", "col_ms", "plan",
         "plan_digest", "installed"}``."""
@@ -1203,13 +1331,15 @@ class DeviceGrower:
 
         reps = max(1, int(reps))
         progs = self.programs
-        if install and progs.plan_source == "profiled":
-            # already measured for this signature in this process
+        if install and progs.plan_source in ("profiled", "persisted"):
+            # already measured for this signature in this process, or
+            # adopted from the on-disk store: zero re-profiles
             return {"stage_ms": {}, "fixed_ms": None, "col_ms": None,
                     "plan": list(progs.stage_plan),
                     "plan_digest":
                         stage_plan_mod.plan_digest(progs.stage_plan),
                     "installed": False}
+        obs.inc("grow.plan_profiles")
         k = progs.hist_cols
         n = progs.n_pad
         rng = np.random.default_rng(0)
@@ -1247,12 +1377,26 @@ class DeviceGrower:
             stage_ms[w] = round(ms, 3)
             obs.observe(f"grow.stage.w{w}", ms / 1e3)
             obs.set_gauge(f"grow.stage.w{w}_ms", round(ms, 3))
+            if w == progs.wave_width:
+                # per-kernel attribution: the full-width probe times the
+                # exact kernel (pallas_int8/einsum_bf16/...) production
+                # dispatches at this stage
+                tag = progs.hist_kernel_tag
+                obs.observe(f"grow.hist.{tag}", ms / 1e3)
+                obs.set_gauge(f"grow.hist.{tag}_ms", round(ms, 3))
         fixed, col = stage_plan_mod.fit_wave_costs(
             widths, [stage_ms[w] for w in widths], k,
             num_data=progs.num_data)
         plan = stage_plan_mod.derive_stage_plan(
             progs.num_leaves, progs.wave_width, k, fixed, col,
             measured_ms=stage_ms)
+        if require_beat_legacy:
+            legacy = stage_plan_mod.legacy_stage_plan(
+                progs.num_leaves, progs.wave_width, k)
+            if not stage_plan_mod.plan_beats(
+                    plan, legacy, progs.num_leaves, k, fixed, col,
+                    measured_ms=stage_ms):
+                plan = legacy
         obs.set_gauge("grow.stage.fixed_ms", round(fixed, 3))
         obs.set_gauge("grow.stage.col_ms", round(col, 5))
         installed = False
@@ -1306,13 +1450,26 @@ class DeviceGrower:
             return self.programs._wave_hist(binned, leaf, ghk, pend,
                                             scales if quant else None)
 
+        int_scan = bool(self.int_scan)
+
         @jax.jit
         def p_find(hists, feature_mask):
+            cons = jnp.asarray([-jnp.inf, jnp.inf], jnp.float32)
+            totals = hists[:, :self.nb, :].sum(1)
+            if int_scan:
+                # the int32 scan variant (what production runs when
+                # quantized); unit scales keep the probe self-contained
+                find_q = functools.partial(find_best_split_quant,
+                                           meta=self.meta, hp=self.hyper,
+                                           has_cat=False)
+                ones2 = jnp.ones((2,), jnp.float32)
+                packed, _, _ = jax.vmap(
+                    lambda hh, t: find_q(hh, t, ones2, cons,
+                                         feature_mask))(hists, totals)
+                return packed
             find_one = functools.partial(find_best_split_impl,
                                          meta=self.meta, hp=self.hyper,
                                          has_cat=False)
-            cons = jnp.asarray([-jnp.inf, jnp.inf], jnp.float32)
-            totals = hists[:, :self.nb, :].sum(1)
             packed, _ = jax.vmap(
                 lambda hh, t: find_one(hh, t, cons, feature_mask))(hists,
                                                                    totals)

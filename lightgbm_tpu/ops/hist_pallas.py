@@ -9,12 +9,24 @@ histograms, ``src/treelearner/ocl/histogram256.cl:343-360``, minus the
 atomics TPU doesn't have):
 
 * grid over row chunks; per step the chunk's bin codes (CH, G) u8,
-  leaf ids (CH, 1) i32 and stat columns (CH, K) bf16 are DMA'd in;
+  leaf ids (CH, 1) i32 and stat columns (CH, K) are DMA'd in;
 * the leaf mask and the B = K*W stat-column matrix are built on the VPU;
 * groups are processed in PAIRS so each one-hot tile is (CH, 128) —
   a full MXU tile — and contracted with the (CH, 128) stat matrix:
   out[pair] += one_hotᵀ @ bmat, accumulated in a VMEM-resident
-  (G*NB, 128) f32 output revisited across all grid steps.
+  (G*NB, 128) output revisited across all grid steps.
+
+Two stat-column representations share the kernel body:
+
+* **bf16** (default training path): bf16 operands, f32 accumulators —
+  the hi/lo column trick reconstructs f32-exact histograms;
+* **int8** (``grad_quant_bits=8``): int8 stochastic-rounded g/h columns
+  (plain [g_q, h_q, mask] or the striped six-column layout past
+  ``ops/grow.COUNT_SPLIT_ROWS``) contracted on the MXU's native
+  int8->int32 path with int32 accumulators.  Integer accumulation is
+  associative, so the kernel is BYTE-identical to the int8 einsum
+  formulation — gated on CPU via interpret mode (tests/test_quant.py,
+  scripts/check_quant.py).
 
 Layout: B columns are K-major (column k*W + w holds stat k of wave slot
 w), so no 3D intermediates touch the minor-most dimension.
@@ -29,6 +41,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .. import obs
+
 _LANES = 128
 
 
@@ -36,48 +50,64 @@ def _ceil_to(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
-def _build_bmat(leaf_ref, pend_ref, gh_ref, ch, k, w, b):
-    """K-major (CH, B) bf16 stat matrix (column kk*W + slot holds stat kk
-    of wave slot), zero-padded to ``b`` lanes.  Shared by both kernels."""
+def _operand_dtypes(ghk_dtype):
+    """(operand dtype, accumulator dtype) for the stat-column dtype;
+    rejects anything the MXU has no native accumulation path for."""
+    if ghk_dtype == jnp.int8:
+        return jnp.int8, jnp.int32
+    if ghk_dtype == jnp.bfloat16:
+        return jnp.bfloat16, jnp.float32
+    raise ValueError(
+        f"pallas wave-histogram supports bf16 or int8 stat columns, "
+        f"got {ghk_dtype} (build bf16 hi/lo or grad_quant_bits=8 int8 "
+        f"columns, or route to the einsum with hist_kernel=einsum)")
+
+
+def _build_bmat(leaf_ref, pend_ref, gh_ref, ch, k, w, b, mdtype):
+    """K-major (CH, B) stat matrix (column kk*W + slot holds stat kk of
+    wave slot), zero-padded to ``b`` lanes.  ``mdtype`` is the operand
+    dtype (bf16 or int8; mask x int8 products stay within int8: the
+    mask is 0/1 and |q| <= 127).  Shared by both kernels."""
     leaf = leaf_ref[:]                                  # (CH, 1) i32
     pend = pend_ref[0:1, :w]                            # (1, W) i32
-    lm = (leaf == pend).astype(jnp.bfloat16)            # (CH, W)
-    gh = gh_ref[:]                                      # (CH, K) bf16
+    lm = (leaf == pend).astype(mdtype)                  # (CH, W)
+    gh = gh_ref[:]                                      # (CH, K)
     cols = [lm * gh[:, kk:kk + 1] for kk in range(k)]
     pad = b - k * w
     if pad:
-        cols.append(jnp.zeros((ch, pad), jnp.bfloat16))
+        cols.append(jnp.zeros((ch, pad), mdtype))
     return jnp.concatenate(cols, axis=1)                # (CH, B)
 
 
-def _pair_one_hot(bins, iota, g0, g):
-    """(CH, 2*NB) bf16 one-hot tile for group pair (g0, g0+1); the casts
+def _pair_one_hot(bins, iota, g0, g, mdtype):
+    """(CH, 2*NB) one-hot tile for group pair (g0, g0+1); the casts
     happen before the concat — Mosaic cannot bitcast i1 vregs through a
     concatenate."""
     if g0 + 1 < g:
         return jnp.concatenate(
-            [(bins[:, g0:g0 + 1] == iota).astype(jnp.bfloat16),
-             (bins[:, g0 + 1:g0 + 2] == iota).astype(jnp.bfloat16)],
+            [(bins[:, g0:g0 + 1] == iota).astype(mdtype),
+             (bins[:, g0 + 1:g0 + 2] == iota).astype(mdtype)],
             axis=1)
-    return (bins[:, g0:g0 + 1] == iota).astype(jnp.bfloat16)
+    return (bins[:, g0:g0 + 1] == iota).astype(mdtype)
 
 
 def _kernel(binned_ref, leaf_ref, gh_ref, pend_ref, out_ref, *,
-            ch: int, g: int, nb: int, k: int, w: int):
+            ch: int, g: int, nb: int, k: int, w: int, mdtype, adtype):
     i = pl.program_id(0)
 
     @pl.when(i == 0)
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    bmat = _build_bmat(leaf_ref, pend_ref, gh_ref, ch, k, w, _LANES)
+    bmat = _build_bmat(leaf_ref, pend_ref, gh_ref, ch, k, w, _LANES,
+                       mdtype)
     bins = binned_ref[:].astype(jnp.int32)              # (CH, G)
     iota = jax.lax.broadcasted_iota(jnp.int32, (ch, nb), 1)
     for g0 in range(0, g, 2):
-        oh = _pair_one_hot(bins, iota, g0, g)
+        oh = _pair_one_hot(bins, iota, g0, g, mdtype)
         acc = jax.lax.dot_general(
             oh, bmat, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)         # (2*NB, 128)
+            preferred_element_type=adtype)              # (2*NB, 128)
         r0 = g0 * nb
         r1 = r0 + acc.shape[0]
         out_ref[r0:r1, :] = out_ref[r0:r1, :] + acc
@@ -94,18 +124,20 @@ def _kernel_v2(binned_ref, leaf_ref, gh_ref, pend_ref, out_ref, oh_ref, *,
     width-independent ~132 ms floor shows the scratch write + dot-from-
     scratch serialize; Mosaic does not overlap the VPU one-hot build
     with the MXU.  Kept as a documented negative result: the einsum's
-    fused one-hot is the best known formulation on this hardware."""
+    fused one-hot is the best known formulation on this hardware.
+    bf16-only (the scratch layout was never ported to int8)."""
     i = pl.program_id(0)
 
     @pl.when(i == 0)
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    bmat = _build_bmat(leaf_ref, pend_ref, gh_ref, ch, k, w, b)
+    bmat = _build_bmat(leaf_ref, pend_ref, gh_ref, ch, k, w, b,
+                       jnp.bfloat16)
     bins = binned_ref[:].astype(jnp.int32)              # (CH, G)
     iota = jax.lax.broadcasted_iota(jnp.int32, (ch, nb), 1)
     for g0 in range(0, g, 2):
-        tile = _pair_one_hot(bins, iota, g0, g)
+        tile = _pair_one_hot(bins, iota, g0, g, jnp.bfloat16)
         oh_ref[:, g0 * nb:g0 * nb + tile.shape[1]] = tile
     acc = jax.lax.dot_general(
         oh_ref[:], bmat, (((0,), (0,)), ((), ())),
@@ -116,11 +148,15 @@ def _kernel_v2(binned_ref, leaf_ref, gh_ref, pend_ref, out_ref, oh_ref, *,
 @functools.partial(jax.jit,
                    static_argnames=("g", "nb", "k", "w", "ch",
                                     "interpret"))
-def wave_hist_pallas_v2(binned, leaf_id, ghk, pending, *, g: int, nb: int,
-                        k: int, w: int, ch: int = 4096,
-                        interpret: bool = False):
+def _wave_hist_pallas_v2(binned, leaf_id, ghk, pending, *, g: int,
+                         nb: int, k: int, w: int, ch: int = 4096,
+                         interpret: bool = False):
     """(n_pad, G) u8, (n_pad,) i32, (n_pad, K) bf16, (W,) i32
     -> (G*NB, K, W) f32 histogram.  B = k*w rounded up to a lane tile."""
+    if ghk.dtype != jnp.bfloat16:
+        raise ValueError(
+            f"pallas wave-histogram v2 is bf16-only (documented negative "
+            f"result), got {ghk.dtype}; use wave_hist_pallas")
     n = binned.shape[0]
     if n % ch:
         raise ValueError(
@@ -161,23 +197,26 @@ def wave_hist_pallas_v2(binned, leaf_id, ghk, pending, *, g: int, nb: int,
     return out[:, :k * w].reshape(g * nb, k, w)
 
 
+wave_hist_pallas_v2 = obs.track_jit("wave_hist_pallas_v2",
+                                    _wave_hist_pallas_v2)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("g", "nb", "k", "w", "ch",
                                     "interpret"))
-def wave_hist_pallas(binned, leaf_id, ghk, pending, *, g: int, nb: int,
-                     k: int, w: int, ch: int = 1024,
-                     interpret: bool = False):
-    """(n_pad, G) u8 bins, (n_pad,) i32 leaf ids, (n_pad, K) bf16 stat
-    columns, (W,) i32 pending -> (G*NB, K, W) f32 histogram.
+def _wave_hist_pallas(binned, leaf_id, ghk, pending, *, g: int, nb: int,
+                      k: int, w: int, ch: int = 1024,
+                      interpret: bool = False):
+    """(n_pad, G) u8 bins, (n_pad,) i32 leaf ids, (n_pad, K) stat
+    columns, (W,) i32 pending -> (G*NB, K, W) histogram.
 
-    bf16-only: the int8 quantized gradient path (grad_quant_bits=8)
-    stays on the XLA einsum, whose int8->int32 contraction already hits
-    the MXU's native path — a VMEM variant would need an int32
-    accumulator layout this kernel does not implement."""
-    if ghk.dtype != jnp.bfloat16:
-        raise ValueError(
-            f"pallas wave-histogram supports bf16 stat columns only, "
-            f"got {ghk.dtype} (grad_quant_bits routes to the einsum)")
+    Stat columns are bf16 (f32 accumulators; the caller's hi/lo column
+    split reconstructs f32-exact sums) or int8 (``grad_quant_bits=8``:
+    int32 accumulators on the MXU's native int8->int32 path, including
+    the striped six-column layout — BYTE-identical to the int8 einsum
+    because integer accumulation is associative).  The output dtype
+    follows the accumulator (f32 or int32)."""
+    mdtype, adtype = _operand_dtypes(ghk.dtype)
     n = binned.shape[0]
     if n % ch:
         raise ValueError(
@@ -198,8 +237,9 @@ def wave_hist_pallas(binned, leaf_id, ghk, pending, *, g: int, nb: int,
     leaf2 = leaf_id.reshape(n, 1)
     pend2 = pending.reshape(1, w)
     out = pl.pallas_call(
-        functools.partial(_kernel, ch=ch, g=g, nb=nb, k=k, w=w),
-        out_shape=jax.ShapeDtypeStruct((g * nb, _LANES), jnp.float32),
+        functools.partial(_kernel, ch=ch, g=g, nb=nb, k=k, w=w,
+                          mdtype=mdtype, adtype=adtype),
+        out_shape=jax.ShapeDtypeStruct((g * nb, _LANES), adtype),
         grid=grid,
         in_specs=[
             pl.BlockSpec((ch, g), lambda i: (i, 0),
@@ -222,3 +262,6 @@ def wave_hist_pallas(binned, leaf_id, ghk, pending, *, g: int, nb: int,
     )(binned, leaf2, ghk, pend2)
     # (G*NB, 128) -> (G*NB, K, W) -> caller reshapes to (W, S, 3)
     return out[:, :k * w].reshape(g * nb, k, w)
+
+
+wave_hist_pallas = obs.track_jit("wave_hist_pallas", _wave_hist_pallas)

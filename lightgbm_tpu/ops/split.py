@@ -186,11 +186,13 @@ def gather_feature_histograms(flat_hist, meta: FeatureMeta):
 
 def reconstruct_default(fh, total, meta: FeatureMeta):
     """Fill each feature's default bin as leaf_total - sum(other bins)
-    (FixHistogram, src/io/dataset.cpp:802-822)."""
+    (FixHistogram, src/io/dataset.cpp:802-822).  dtype-generic: the
+    int32 quantized scan reconstructs EXACTLY (integer subtraction),
+    where the f32 path carries the usual accumulation rounding."""
     b = jnp.arange(256, dtype=jnp.int32)[None, :]
     default_vals = total[None, :] - fh.sum(axis=1)
     default_vals = default_vals.at[:, 2].set(
-        jnp.maximum(default_vals[:, 2], 0.0))
+        jnp.maximum(default_vals[:, 2], 0))
     is_default = (b == meta.default_bin[:, None]) & (b < meta.num_bin[:, None])
     return jnp.where(is_default[..., None], default_vals[:, None, :], fh)
 
@@ -209,7 +211,8 @@ class PerFeatureBest(NamedTuple):
     gain: jnp.ndarray        # (F,) raw child-gain sum, NEG_INF when invalid
     threshold: jnp.ndarray   # (F,) int32 numerical threshold bin
     default_left: jnp.ndarray  # (F,) bool
-    left: jnp.ndarray        # (F, 3) left-child (g, h, c)
+    left: jnp.ndarray        # (F, 3) left-child (g, h, c); int32 in
+    #                          quantized units under the int32 scan
     is_cat: jnp.ndarray      # (F,) bool
     cat_member: jnp.ndarray  # (F, 256) bool membership of the cat candidate
     cat_extra_l2: jnp.ndarray  # (F,) additional l2 for the winning cat mode
@@ -217,7 +220,16 @@ class PerFeatureBest(NamedTuple):
 
 def per_feature_best(fh, total, constraint, meta: FeatureMeta,
                      hp: SplitHyper, has_cat: bool,
-                     min_gain_shift) -> PerFeatureBest:
+                     min_gain_shift, scales=None) -> PerFeatureBest:
+    """``scales`` switches on the int32 quantized scan
+    (``grad_quant_bits=8``, ROUND8_NOTES.md): ``fh`` is then the int32
+    [g_q, h_q, count] histogram and ``scales`` the (3,) [scale_g,
+    scale_h, 1] dequantization vector, while ``total`` is ALWAYS in
+    real (dequantized) units.  All prefix sums run in int32 — EXACT,
+    no f32 accumulation error across the 256-bin axis — and values are
+    dequantized only where the gain/output math needs real units.
+    ``pf.left`` keeps the raw integer units so the caller can carry
+    exact child totals."""
     tg, th, tc = total[0], total[1] + 2.0 * K_EPSILON, total[2]
     cmin, cmax = constraint[0], constraint[1]
     l1, l2, mds = hp.lambda_l1, hp.lambda_l2, hp.max_delta_step
@@ -245,6 +257,10 @@ def per_feature_best(fh, total, constraint, meta: FeatureMeta,
     left0 = prefix + miss_stats[:, None, :]
     left1 = prefix
     lefts = jnp.stack([left0, left1], axis=1)            # (F,2,256,3)
+    # int32 scan: candidate stats leave the integer domain HERE — one
+    # multiply per candidate, after the exact prefix sums
+    lefts_f = lefts if scales is None \
+        else lefts.astype(jnp.float32) * scales
 
     t_ok = b < meta.num_bin[:, None] - 1                 # right side real bins
     two_dir = ((miss == 2) & (nb > 2)) | zero_sep
@@ -257,9 +273,9 @@ def per_feature_best(fh, total, constraint, meta: FeatureMeta,
     v1_ok = v1_ok & ~(zero_sep & (b == db))
     var_ok = jnp.stack([v0_ok, v1_ok], axis=1)           # (F,2,256)
 
-    gl = lefts[..., 0]
-    hl = lefts[..., 1] + K_EPSILON
-    cl = lefts[..., 2]
+    gl = lefts_f[..., 0]
+    hl = lefts_f[..., 1] + K_EPSILON
+    cl = lefts_f[..., 2]
     gr, hr, cr = tg - gl, th - hl, tc - cl
     data_ok = ((cl >= hp.min_data_in_leaf) & (cr >= hp.min_data_in_leaf)
                & (hl >= hp.min_sum_hessian_in_leaf)
@@ -286,10 +302,13 @@ def per_feature_best(fh, total, constraint, meta: FeatureMeta,
     # =====================================================================
     # categorical
     # =====================================================================
+    fh_f = fh if scales is None else fh.astype(jnp.float32) * scales
     cnt = fh[..., 2]
     used_bin_mask = b < (meta.num_bin[:, None] - 1 + (miss == 0))
-    # one-hot mode: left = single bin t (regular l2)
-    oh_gl, oh_hl, oh_cl = fh[..., 0], fh[..., 1] + K_EPSILON, cnt
+    # one-hot mode: left = single bin t (regular l2); single-bin stats
+    # dequantize exactly (one multiply, no summation)
+    oh_gl, oh_hl, oh_cl = fh_f[..., 0], fh_f[..., 1] + K_EPSILON, \
+        fh_f[..., 2]
     oh_gr, oh_hr, oh_cr = tg - oh_gl, th - oh_hl, tc - oh_cl
     oh_ok = (used_bin_mask & (oh_cl >= hp.min_data_in_leaf)
              & (oh_cr >= hp.min_data_in_leaf)
@@ -306,7 +325,8 @@ def per_feature_best(fh, total, constraint, meta: FeatureMeta,
     l2c = l2 + hp.cat_l2
     eligible = used_bin_mask & (cnt >= hp.cat_smooth)
     n_used = eligible.sum(axis=1).astype(jnp.float32)    # (F,)
-    ratio = jnp.where(eligible, fh[..., 0] / (fh[..., 1] + hp.cat_smooth),
+    ratio = jnp.where(eligible,
+                      fh_f[..., 0] / (fh_f[..., 1] + hp.cat_smooth),
                       jnp.inf)
     order = jnp.argsort(ratio, axis=1, stable=True)      # (F,256)
     sorted_fh = jnp.take_along_axis(fh, order[..., None], 1)
@@ -317,9 +337,10 @@ def per_feature_best(fh, total, constraint, meta: FeatureMeta,
                               jnp.floor((n_used + 1.0) / 2.0))[:, None]
 
     def _cat_scan(sfh):
-        ps = jnp.cumsum(sfh, axis=1)
+        ps = jnp.cumsum(sfh, axis=1)                     # exact when int
+        psf = ps if scales is None else ps.astype(jnp.float32) * scales
         k = rank + 1.0                                   # bins taken
-        sgl, shl, scl = ps[..., 0], ps[..., 1] + K_EPSILON, ps[..., 2]
+        sgl, shl, scl = psf[..., 0], psf[..., 1] + K_EPSILON, psf[..., 2]
         sgr, shr, scr = tg - sgl, th - shl, tc - scl
         ok = ((k <= max_num_cat)
               & (k <= jnp.maximum(n_used[:, None] - 1.0, 0.0))
@@ -333,7 +354,7 @@ def per_feature_best(fh, total, constraint, meta: FeatureMeta,
         return g, ps
 
     fwd_gains, _ = _cat_scan(sorted_fh)
-    rev_fh = jnp.flip(jnp.where(sorted_el[..., None], sorted_fh, 0.0), axis=1)
+    rev_fh = jnp.flip(jnp.where(sorted_el[..., None], sorted_fh, 0), axis=1)
     # reversed order: take from the high-ratio end of the eligible prefix;
     # roll so eligible entries lead
     shift_amt = (256 - n_used.astype(jnp.int32))
@@ -359,7 +380,8 @@ def per_feature_best(fh, total, constraint, meta: FeatureMeta,
                   & eligible)
     oh_member = b == oh_arg[:, None]
     cat_member = jnp.where(use_onehot[:, None], oh_member, srt_member)
-    cat_left = jnp.einsum("fb,fbk->fk", cat_member.astype(jnp.float32), fh)
+    # raw-unit left stats (exact int32 sums under the quantized scan)
+    cat_left = jnp.einsum("fb,fbk->fk", cat_member.astype(fh.dtype), fh)
     cat_extra_l2 = jnp.where(use_onehot, 0.0, hp.cat_l2)
 
     is_cat = meta.is_cat == 1
@@ -384,13 +406,18 @@ def masked_feature_gain(pf: PerFeatureBest, meta: FeatureMeta, feature_mask,
 
 
 def pack_best(best_f, feat_gain, pf: PerFeatureBest, total, constraint,
-              hp: SplitHyper, meta: FeatureMeta):
+              hp: SplitHyper, meta: FeatureMeta, scales=None):
     """Pack the winning feature's split into the 13-float record (+ its
-    categorical membership row).  ``best_f`` is a traced local index."""
+    categorical membership row).  ``best_f`` is a traced local index.
+    Under the int32 quantized scan ``pf.left`` carries quantized-unit
+    integers and ``scales`` dequantizes them, so the packed record
+    always reports REAL units (host tree replay is scan-agnostic)."""
     tg, th, tc = total[0], total[1] + 2.0 * K_EPSILON, total[2]
     cmin, cmax = constraint[0], constraint[1]
     l1, l2, mds = hp.lambda_l1, hp.lambda_l2, hp.max_delta_step
     left = pf.left[best_f]
+    if scales is not None:
+        left = left.astype(jnp.float32) * scales
     best_is_cat = pf.is_cat[best_f]
     lg, lh, lc = left[0], left[1] + K_EPSILON, left[2]
     rg = tg - lg
@@ -431,6 +458,40 @@ def find_best_split_impl(flat_hist, total, constraint, feature_mask,
     feat_gain = masked_feature_gain(pf, meta, feature_mask, shift)
     best_f = jnp.argmax(feat_gain)
     return pack_best(best_f, feat_gain, pf, total, constraint, hp, meta)
+
+
+def find_best_split_quant(flat_hist, total, scales, constraint,
+                          feature_mask, meta: FeatureMeta, hp: SplitHyper,
+                          has_cat: bool):
+    """Quantized-unit serial chain (``grad_quant_bits=8``): the int32
+    [g_q, h_q, count] histogram stays INTEGER through default-bin
+    reconstruction and every prefix sum — both numerical scan variants
+    and both categorical scan directions — and is dequantized only at
+    the gain / leaf-output math.  Counts never leave the integer
+    domain, so the histogram-subtraction trick and leaf totals are
+    exact (the f32 path's accumulation-order sensitivity disappears).
+
+    ``flat_hist`` (S, 3) int32, ``total`` (3,) int32 quantized units,
+    ``scales`` (2,) f32 [scale_g, scale_h].  Returns (packed (13,) f32
+    real units, cat_member (256,) bool, left_int (3,) int32 — the
+    winner's exact quantized-unit left-child totals; the caller derives
+    the right child by integer subtraction from the parent total).
+
+    Overflow contract: every intermediate is bounded by |sum| <=
+    127 * num_data, so int32 is exact for num_data <=
+    ``ops.grow.INT32_SCAN_ROWS``; larger datasets keep the dequantized
+    f32 scan (ROUND8_NOTES.md)."""
+    svec = jnp.concatenate([scales, jnp.ones((1,), jnp.float32)])
+    total_f = total.astype(jnp.float32) * svec
+    shift = min_gain_shift_of(total_f, hp)
+    fh = feature_histograms(flat_hist, total, meta)      # int32 exact
+    pf = per_feature_best(fh, total_f, constraint, meta, hp, has_cat,
+                          shift, scales=svec)
+    feat_gain = masked_feature_gain(pf, meta, feature_mask, shift)
+    best_f = jnp.argmax(feat_gain)
+    packed, catm = pack_best(best_f, feat_gain, pf, total_f, constraint,
+                             hp, meta, scales=svec)
+    return packed, catm, pf.left[best_f]
 
 
 @functools.partial(jax.jit, static_argnames=("has_cat",))

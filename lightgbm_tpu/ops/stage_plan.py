@@ -32,6 +32,8 @@ byte-identical across releases.
 from __future__ import annotations
 
 import hashlib
+import json
+import os
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -47,6 +49,12 @@ Plan = List[Tuple[int, Optional[int]]]
 
 _PLAN_CACHE: Dict[tuple, Plan] = {}
 _PLAN_CACHE_LOCK = threading.Lock()
+
+# wave_plan=auto profiles on first use only at production scale: below
+# this many training rows the whole tree costs milliseconds and the
+# probe compiles would dominate (small CPU tests/windows keep the
+# byte-stable legacy plan with zero measurement overhead)
+AUTO_PROFILE_MIN_ROWS = 1 << 19
 
 
 def legacy_stage_plan(num_leaves: int, wave_width: int,
@@ -114,6 +122,32 @@ def _ladder(wave_width: int) -> List[int]:
 MIN_IMPROVEMENT = 0.02
 
 
+def wave_cost_fn(hist_cols: int, fixed_ms: float, col_ms: float,
+                 measured_ms: Optional[Dict[int, float]] = None):
+    """Per-width wave cost (ms): the measured probe timing when one
+    exists for the width, else the linear fixed + col * width * k model
+    — shared by ``derive_stage_plan`` and ``plan_beats`` so the
+    derivation and the legacy-bar comparison price plans identically."""
+    def wave_ms(w):
+        if measured_ms and w in measured_ms:
+            return float(measured_ms[w])
+        return fixed_ms + col_ms * w * hist_cols
+    return wave_ms
+
+
+def plan_beats(candidate: Sequence, incumbent: Sequence, num_leaves: int,
+               hist_cols: int, fixed_ms: float, col_ms: float,
+               measured_ms: Optional[Dict[int, float]] = None) -> bool:
+    """Whether ``candidate``'s modeled per-tree cost beats
+    ``incumbent``'s by the ``MIN_IMPROVEMENT`` bar — the gate
+    ``wave_plan=auto`` applies before displacing the byte-stable legacy
+    ladder with a freshly measured plan."""
+    wave_ms = wave_cost_fn(hist_cols, fixed_ms, col_ms, measured_ms)
+    c_cand, _ = plan_cost_fn(candidate, num_leaves, wave_ms)
+    c_inc, _ = plan_cost_fn(incumbent, num_leaves, wave_ms)
+    return c_cand < c_inc * (1.0 - MIN_IMPROVEMENT)
+
+
 def derive_stage_plan(num_leaves: int, wave_width: int, hist_cols: int,
                       fixed_ms: float, col_ms: float,
                       measured_ms: Optional[Dict[int, float]] = None
@@ -130,10 +164,7 @@ def derive_stage_plan(num_leaves: int, wave_width: int, hist_cols: int,
     the linear (fixed, col) model only fills unprobed widths.  Candidates
     are scanned fewest-stages-first and a longer plan must be at least
     ``MIN_IMPROVEMENT`` cheaper to displace the incumbent."""
-    def wave_ms(w):
-        if measured_ms and w in measured_ms:
-            return float(measured_ms[w])
-        return fixed_ms + col_ms * w * hist_cols
+    wave_ms = wave_cost_fn(hist_cols, fixed_ms, col_ms, measured_ms)
 
     rungs = _ladder(wave_width)
     candidates: List[Plan] = [[(wave_width, None)]]
@@ -177,7 +208,107 @@ def cached_plan(signature: tuple) -> Optional[Plan]:
         return list(plan) if plan is not None else None
 
 
-def cache_plan(signature: tuple, plan: Sequence) -> None:
+def cache_plan(signature: tuple, plan: Sequence,
+               persist: bool = True) -> None:
+    """Record ``plan`` for ``signature`` in the process cache and —
+    unless ``persist=False`` — write it through to the on-disk store
+    beside the compile cache, so fresh processes adopt it without
+    re-profiling (``persist=False`` is for plans that CAME from disk)."""
     with _PLAN_CACHE_LOCK:
         _PLAN_CACHE[signature] = [(int(w), None if c is None else int(c))
                                   for w, c in plan]
+    if persist:
+        save_plan(signature, plan)
+
+
+# ---------------------------------------------------------------------------
+# on-disk persistence: profiled plans live beside the persistent XLA
+# compile cache (ROADMAP 1c).  A stage plan shapes the traced program,
+# so a cross-process warm start needs BOTH the compiled executables and
+# the plan they were compiled for — co-locating them makes "warm the
+# cache dir" one operation.  Files are keyed on a sha1 of the grower's
+# (shape, config) signature repr (PYTHONHASHSEED-independent — the same
+# property tests pin for programs_signature itself) and verified on
+# load: signature text must match exactly and the stored digest must
+# match the stored plan, so a corrupt or hand-edited file degrades to
+# the legacy plan instead of training with an unvetted stage order.
+# ---------------------------------------------------------------------------
+
+def store_dir() -> Optional[str]:
+    """``<compile_cache_dir>/stage_plans``, or None when no persistent
+    compile cache is active (plans then live for the process only)."""
+    from .. import compile_cache
+    return compile_cache.artifact_dir("stage_plans")
+
+
+def _plan_path(signature: tuple) -> Optional[str]:
+    d = store_dir()
+    if d is None:
+        return None
+    key = hashlib.sha1(repr(tuple(signature)).encode()).hexdigest()[:20]
+    return os.path.join(d, f"plan_{key}.json")
+
+
+def save_plan(signature: tuple, plan: Sequence) -> Optional[str]:
+    """Atomically persist ``plan``; returns the path, or None when no
+    store is active or the write fails (best-effort — a read-only cache
+    dir must not take down training over a plan)."""
+    path = _plan_path(signature)
+    if path is None:
+        return None
+    canon = [[int(w), None if c is None else int(c)] for w, c in plan]
+    payload = {"signature": repr(tuple(signature)),
+               "plan": canon,
+               "digest": plan_digest(canon)}
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh)
+        os.replace(tmp, path)
+    except OSError as e:
+        from ..utils.log import log_warning
+        log_warning(f"cannot persist the profiled stage plan to "
+                    f"{path}: {e}; the plan stays process-local")
+        try:
+            os.unlink(tmp)    # don't leave orphaned .tmp files behind
+        except OSError:
+            pass
+        return None
+    return path
+
+
+def load_plan(signature: tuple) -> Optional[Plan]:
+    """Load a persisted plan for ``signature``; None (-> legacy plan)
+    when absent, unreadable, signature-mismatched, or digest-corrupt."""
+    path = _plan_path(signature)
+    if path is None or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if payload.get("signature") != repr(tuple(signature)):
+        return None
+    try:
+        plan = [(int(w), None if c is None else int(c))
+                for w, c in payload.get("plan")]
+    except (TypeError, ValueError):
+        return None
+    if not plan or plan_digest(plan) != payload.get("digest"):
+        return None
+    return plan
+
+
+def forget_plan(signature: tuple) -> None:
+    """Drop ``signature``'s plan from the process cache AND the disk
+    store (tests and operators invalidating a stale measurement)."""
+    with _PLAN_CACHE_LOCK:
+        _PLAN_CACHE.pop(signature, None)
+    path = _plan_path(signature)
+    if path is not None:
+        try:
+            os.remove(path)
+        except OSError:
+            pass

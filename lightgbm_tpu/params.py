@@ -474,10 +474,14 @@ PARAM_SCHEMA: Sequence[Param] = (
     _p("hist_kernel", str, "auto", (),
        check="auto/pallas/einsum/interpret",
        desc="wave-histogram implementation for the device grower: "
-            "einsum = XLA one-hot matmul (default; fastest measured), "
-            "pallas = VMEM-resident Pallas TPU kernel (ops/hist_pallas.py, "
-            "experimental: currently slower than the einsum), interpret = "
-            "Pallas interpreter mode (CPU testing), auto = einsum",
+            "einsum = XLA one-hot matmul (default; fastest measured for "
+            "bf16), pallas = VMEM-resident Pallas TPU kernel "
+            "(ops/hist_pallas.py; serves full-width waves whose stat "
+            "columns fit one 128-lane tile, bf16 or int8 — the int8 "
+            "variant accumulates int8->int32 on the MXU and is "
+            "byte-identical to the int8 einsum), interpret = Pallas "
+            "interpreter mode (CPU testing/CI parity), auto = einsum. "
+            "Routing per dispatch is recorded as grow.hist.* counters",
        section="device"),
     _p("grad_quant_bits", int, 0, ("gradient_quant_bits", "quant_bits"),
        check=">= 0",
@@ -485,11 +489,15 @@ PARAM_SCHEMA: Sequence[Param] = (
             "0 (default) = full-precision bf16 hi/lo wave histograms; 8 = "
             "stochastically round grad/hess to int8 against a per-tree "
             "global scale so the wave contraction runs on the MXU's native "
-            "int8->int32 path. Histograms are dequantized once in f32 "
-            "before split-gain evaluation, counts stay integer-exact, and "
-            "leaf values are refit from full-precision gradients after "
-            "growth (Shi et al., Quantized Training of GBDT, NeurIPS "
-            "2022). Ignored with gpu_use_dp. Only 0 and 8 are accepted",
+            "int8->int32 path. Below ~16.9M rows (ops/grow."
+            "INT32_SCAN_ROWS) the histograms stay INTEGER end-to-end "
+            "through the find-best prefix-sum scan — counts, default-bin "
+            "reconstruction and histogram subtraction are exact — and are "
+            "dequantized only at gain/leaf-value math; larger datasets "
+            "dequantize once in f32 before the scan. Leaf values are "
+            "refit from full-precision gradients after growth either way "
+            "(Shi et al., Quantized Training of GBDT, NeurIPS 2022). "
+            "Ignored with gpu_use_dp. Only 0 and 8 are accepted",
        section="device"),
     _p("wave_plan", str, "auto", (),
        check="auto/fixed/profiled",
@@ -497,9 +505,17 @@ PARAM_SCHEMA: Sequence[Param] = (
             "fixed = the byte-stable doubling plan; profiled = time every "
             "candidate stage width on the real binned matrix at init, fit "
             "the fixed-vs-per-column wave cost model and install the "
-            "cheapest plan (cached per (shape, config) signature, so "
-            "retrain windows measure once); auto = the fixed plan unless "
-            "a profiled plan is already cached for this signature",
+            "cheapest plan; auto = adopt a plan already cached for this "
+            "(shape, config) signature (in process or persisted beside "
+            "the compile cache), else profile ON FIRST USE at production "
+            "scale (>= 2^19 training rows AND a persistent compile cache "
+            "active, so the verdict persists — probe timings are noisy, "
+            "and an unpersistable plan would let same-config processes "
+            "grow different trees) and install the derived plan only "
+            "when it beats the byte-stable ladder by the 2% bar. "
+            "Profiled plans persist to <compile_cache_dir>/stage_plans "
+            "so retrain windows AND fresh processes measure once "
+            "(zero re-profiles; docs/ColdStart.md)",
        section="device"),
     _p("grower_cache", bool, True, (),
        desc="share the device grower's jitted programs process-wide, "

@@ -45,7 +45,8 @@ step "jaxlint" python -m lightgbm_tpu.tools.jaxlint lightgbm_tpu \
 #     file's debt can only ratchet down — this step pins an absolute
 #     zero-findings contract for the listed files
 step "jaxlint (zero-debt modules)" python -m lightgbm_tpu.tools.jaxlint \
-    lightgbm_tpu/ops/stage_plan.py lightgbm_tpu/serve \
+    lightgbm_tpu/ops/stage_plan.py lightgbm_tpu/ops/hist_pallas.py \
+    lightgbm_tpu/serve \
     lightgbm_tpu/pipeline lightgbm_tpu/robust --no-baseline
 
 # 3. the telemetry schema validator validates itself
@@ -74,6 +75,12 @@ if [[ "${1:-}" != "--fast" ]]; then
     #     model, and serving under injected device death answers every
     #     request host-exact then recovers (docs/Robustness.md)
     step "fault smoke" python scripts/check_faults.py
+
+    # 5d. quant smoke: the int8 Pallas wave-histogram kernel (interpret
+    #     mode) must be BYTE-identical to the int8 einsum at kernel and
+    #     whole-training level, with the int32 find-best scan active
+    #     (ROUND8_NOTES.md)
+    step "quant smoke" python scripts/check_quant.py
 
     tier1() {
         rm -f /tmp/_t1.log

@@ -16,6 +16,12 @@ Fast contract check for the persistent-compile-cache story
 A nonzero miss count means some program the production path dispatches
 is not covered by the warmup's schedule — exactly the regression this
 smoke exists to catch.
+
+A second phase gates the PERSISTED STAGE PLAN contract (ROADMAP 1c):
+a ``wave_plan=profiled`` run measures once and persists the derived
+plan beside the compile cache; a FRESH subprocess of the same
+declaration must adopt it from disk — plan_source ``persisted``, the
+same plan digest, and ZERO re-profiles (``grow.plan_profiles`` == 0).
 """
 
 from __future__ import annotations
@@ -49,7 +55,12 @@ def probe() -> int:
     from lightgbm_tpu.warmup import _synth_dataset
 
     set_verbosity(-1)
-    cfg = Config(dict(kv.split("=", 1) for kv in DECLARATION))
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.ops import stage_plan as sp
+
+    obs.configure(enabled=True)
+    extra = [a.split("=", 1) for a in sys.argv[2:] if "=" in a]
+    cfg = Config(dict([kv.split("=", 1) for kv in DECLARATION] + extra))
     compile_cache.configure_from_config(cfg)
     ds = _synth_dataset(ROWS, FEATURES, cfg)
     bst = create_boosting(cfg)
@@ -57,7 +68,13 @@ def probe() -> int:
     bst.train_chunked(cfg.num_iterations, chunk=cfg.fused_chunk)
     jax.block_until_ready(bst.train_score)
     bst._flush_pending()
-    print(json.dumps(compile_cache.counters()))
+    out = compile_cache.counters()
+    grower = getattr(bst, "_grower", None)
+    out["plan_source"] = getattr(grower, "plan_source", None)
+    out["plan_digest"] = sp.plan_digest(grower.stage_plan) \
+        if grower is not None else None
+    out["plan_profiles"] = obs.registry().counter("grow.plan_profiles")
+    print(json.dumps(out))
     return 0
 
 
@@ -90,6 +107,23 @@ def main() -> int:
                   f"{r.stderr[-2000:]}")
             return 1
         counters = json.loads(r.stdout.strip().splitlines()[-1])
+
+        # phase 2 — persisted stage plans: a profiled run measures once
+        # and persists beside the compile cache; a fresh subprocess of
+        # the same declaration must adopt the plan from disk with ZERO
+        # re-profiles (ROADMAP 1c / bench --suite coldstart's analog)
+        runs = []
+        for tag in ("profiled", "adopt"):
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--probe",
+                 "wave_plan=profiled"], env=env, cwd=repo,
+                capture_output=True, text=True)
+            if r.returncode != 0:
+                print(f"FAIL stage-plan {tag} probe rc={r.returncode}:\n"
+                      f"{r.stderr[-2000:]}")
+                return 1
+            runs.append(json.loads(r.stdout.strip().splitlines()[-1]))
+        plan_first, plan_second = runs
     print(f"coldstart smoke: warmup wrote {entries} cache entries; "
           f"fresh training run: {counters['hits']} hits, "
           f"{counters['misses']} misses")
@@ -101,6 +135,23 @@ def main() -> int:
     if counters["hits"] <= 0:
         print("FAIL: the training run never consulted the persistent "
               "cache (is it disabled?)")
+        return 1
+    print(f"stage plans: first run profiled {plan_first['plan_profiles']}"
+          f"x (source={plan_first['plan_source']}); fresh run "
+          f"re-profiled {plan_second['plan_profiles']}x "
+          f"(source={plan_second['plan_source']})")
+    if plan_first["plan_profiles"] != 1:
+        print("FAIL: the wave_plan=profiled run did not measure exactly "
+              "once")
+        return 1
+    if plan_second["plan_profiles"] != 0 \
+            or plan_second["plan_source"] != "persisted":
+        print("FAIL: the fresh subprocess re-profiled instead of "
+              "adopting the persisted stage plan")
+        return 1
+    if plan_second["plan_digest"] != plan_first["plan_digest"]:
+        print("FAIL: the adopted stage plan differs from the persisted "
+              "one (digest mismatch)")
         return 1
     print("coldstart smoke: PASS")
     return 0

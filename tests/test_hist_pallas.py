@@ -1,0 +1,124 @@
+"""Pallas wave-histogram kernel (ops/hist_pallas.py): pad/layout edge
+cases and the int8 variant, all in interpret mode on CPU.
+
+The kernel's contracts the grower relies on:
+
+* bf16 stat columns -> f32 accumulators, int8 -> int32 (byte-identical
+  to the einsum — integer accumulation is associative);
+* rows must divide the grid chunk (ValueError otherwise, not silent
+  truncation);
+* all stat columns must fit ONE 128-lane tile (k*w <= 128 ValueError —
+  a documented single-tile kernel, multi-tile waves stay on the einsum);
+* odd group counts exercise the pair loop's single-group tail.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops.hist_pallas import wave_hist_pallas
+
+
+def _np_ref(binned, leaf, ghk, pending, g, nb, k, w):
+    """Direct scalar accumulation oracle: out[gi*nb + b, kk, wi] = sum
+    of ghk[row, kk] over rows with binned[row, gi] == b and
+    leaf[row] == pending[wi]."""
+    out = np.zeros((g * nb, k, w), np.float64)
+    ghk64 = np.asarray(ghk, np.float64)
+    for wi in range(w):
+        rows = np.asarray(leaf) == int(pending[wi])
+        for gi in range(g):
+            idx = gi * nb + np.asarray(binned)[rows, gi].astype(np.int64)
+            for kk in range(k):
+                np.add.at(out[:, kk, wi], idx, ghk64[rows, kk])
+    return out
+
+
+def _inputs(n=2048, g=3, nb=64, k=3, w=5, seed=0, dtype=jnp.int8):
+    rng = np.random.default_rng(seed)
+    binned = jnp.asarray(rng.integers(0, nb, (n, g)).astype(np.uint8))
+    leaf = jnp.asarray(rng.integers(-1, w + 1, n).astype(np.int32))
+    if dtype == jnp.int8:
+        ghk = jnp.asarray(rng.integers(-127, 128, (n, k))
+                          .astype(np.int8))
+    else:
+        ghk = jnp.asarray(rng.standard_normal((n, k)).astype(np.float32)
+                          .astype(np.float16)).astype(dtype)
+    pending = jnp.arange(w, dtype=jnp.int32)
+    return binned, leaf, ghk, pending
+
+
+def test_int8_kernel_matches_oracle_odd_groups():
+    """int8 -> int32 accumulation, odd group count (the pair loop's
+    single-group tail), bit-exact against the scalar oracle."""
+    g, nb, k, w = 3, 64, 3, 5
+    binned, leaf, ghk, pending = _inputs(g=g, k=k, w=w)
+    out = wave_hist_pallas(binned, leaf, ghk, pending, g=g, nb=nb,
+                           k=k, w=w, interpret=True)
+    assert out.dtype == jnp.int32
+    ref = _np_ref(binned, leaf, ghk, pending, g, nb, k, w)
+    np.testing.assert_array_equal(np.asarray(out, np.int64),
+                                  ref.astype(np.int64))
+
+
+def test_int8_kernel_striped_six_columns():
+    """The striped layout's six int8 stat columns (>= 2^24-row datasets,
+    ops/grow.py k=6) fit the same kernel; exact vs the oracle."""
+    g, nb, k, w = 2, 64, 6, 4
+    binned, leaf, ghk, pending = _inputs(g=g, k=k, w=w, seed=3)
+    out = wave_hist_pallas(binned, leaf, ghk, pending, g=g, nb=nb,
+                           k=k, w=w, interpret=True)
+    assert out.dtype == jnp.int32
+    ref = _np_ref(binned, leaf, ghk, pending, g, nb, k, w)
+    np.testing.assert_array_equal(np.asarray(out, np.int64),
+                                  ref.astype(np.int64))
+
+
+def test_bf16_kernel_matches_oracle():
+    """bf16 columns keep the f32 accumulator path (regression: the int8
+    extension must not perturb the original kernel)."""
+    g, nb, k, w = 3, 64, 3, 5
+    binned, leaf, ghk, pending = _inputs(g=g, k=k, w=w, seed=1,
+                                         dtype=jnp.bfloat16)
+    out = wave_hist_pallas(binned, leaf, ghk, pending, g=g, nb=nb,
+                           k=k, w=w, interpret=True)
+    assert out.dtype == jnp.float32
+    ref = _np_ref(binned, leaf, np.asarray(ghk, np.float32), pending,
+                  g, nb, k, w)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-2,
+                               rtol=1e-3)
+
+
+def test_rows_not_divisible_by_chunk_raises():
+    """CH not dividing n_pad is a loud ValueError, never a silent
+    truncation of the tail rows."""
+    binned, leaf, ghk, pending = _inputs(n=1500, g=2, w=4)
+    with pytest.raises(ValueError, match="divisible"):
+        wave_hist_pallas(binned, leaf, ghk, pending, g=2, nb=64, k=3,
+                         w=4, interpret=True)
+    # explicit non-dividing chunk on an otherwise fine row count
+    binned, leaf, ghk, pending = _inputs(n=2048, g=2, w=4)
+    with pytest.raises(ValueError, match="divisible"):
+        wave_hist_pallas(binned, leaf, ghk, pending, g=2, nb=64, k=3,
+                         w=4, ch=768, interpret=True)
+
+
+def test_kw_over_one_tile_raises_for_int8_too():
+    """The single-tile contract (k*w <= 128) gates the int8 variant the
+    same way as bf16 (test_coldstart pins the bf16 case)."""
+    binned, leaf, ghk, pending = _inputs(n=1024, g=1, k=6, w=4)
+    pend_wide = jnp.arange(32, dtype=jnp.int32)
+    with pytest.raises(ValueError, match="lane"):
+        wave_hist_pallas(binned, leaf, ghk, pend_wide, g=1, nb=64,
+                         k=6, w=32, interpret=True)
+
+
+def test_unsupported_dtype_message_names_both_paths():
+    """f32 stat columns are rejected with a message naming the accepted
+    dtypes (the old 'bf16 only' text went stale when int8 landed)."""
+    binned, leaf, _, pending = _inputs(n=1024, g=1, w=4)
+    ghk32 = jnp.zeros((1024, 3), jnp.float32)
+    with pytest.raises(ValueError, match="bf16 or int8"):
+        wave_hist_pallas(binned, leaf, ghk32, pending, g=1, nb=64,
+                         k=3, w=4, interpret=True)

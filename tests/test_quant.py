@@ -144,6 +144,76 @@ def test_quant_fused_parity_with_fork_harness_config():
     _assert_bit_identical(a, b)
 
 
+def test_quant_pallas_byte_identical_to_einsum():
+    """The int8 Pallas wave-histogram kernel (interpret mode on CPU)
+    must yield BYTE-identical models to the int8 einsum: both
+    accumulate int8->int32 and integer addition is associative, so any
+    divergence is a layout/masking bug, never rounding."""
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((3000, 10)).astype(np.float32)
+    y = (x[:, 0] + np.abs(x[:, 1]) > 0.5).astype(np.float32)
+    base = {"grad_quant_bits": 8, "num_leaves": 15,
+            "min_data_in_leaf": 5}
+    a = _train({**base, "hist_kernel": "einsum"}, x, y, 5)
+    b = _train({**base, "hist_kernel": "interpret"}, x, y, 5)
+    assert a._grower.hist_kernel_tag == "einsum_int8"
+    assert b._grower.hist_kernel_tag == "pallas_int8"
+    assert a._grower.int_scan and b._grower.int_scan
+    _assert_bit_identical(a, b)
+
+
+def test_quant_pallas_striped_byte_identical():
+    """Same contract on the striped six-column layout (the >= 2^24-row
+    path, forced small via COUNT_SPLIT_ROWS)."""
+    import lightgbm_tpu.ops.grow as growmod
+
+    rng = np.random.default_rng(8)
+    n = 6000
+    x = rng.standard_normal((n, 6)).astype(np.float32)
+    y = (x[:, 0] + 2 * (x[:, 1] > 0.3) > 0.5).astype(np.float32)
+    base = {"grad_quant_bits": 8, "num_leaves": 15, "seed": 77}
+    old = growmod.COUNT_SPLIT_ROWS
+    try:
+        growmod.COUNT_SPLIT_ROWS = 5000
+        a = _train({**base, "hist_kernel": "einsum"}, x, y, 4)
+        b = _train({**base, "hist_kernel": "interpret"}, x, y, 4)
+        assert a._grower.hist_cols == b._grower.hist_cols == 6
+        assert b._grower.hist_kernel_tag == "pallas_int8"
+        _assert_bit_identical(a, b)
+    finally:
+        growmod.COUNT_SPLIT_ROWS = old
+
+
+def test_quant_int_scan_bound_and_f32_fallback():
+    """The int32 find-best scan engages below INT32_SCAN_ROWS (every
+    |sum| <= 127 * rows fits int32) and falls back to the PR-4 f32
+    dequantized scan above it — the fallback still trains and keeps
+    counts integer-exact."""
+    import lightgbm_tpu.ops.grow as growmod
+
+    assert growmod.INT32_SCAN_ROWS == ((1 << 31) - 1) // 127
+    rng = np.random.default_rng(12)
+    x = rng.standard_normal((2000, 6)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    old = growmod.INT32_SCAN_ROWS
+    try:
+        growmod.INT32_SCAN_ROWS = 1000    # force the f32 fallback
+        b = _train({"grad_quant_bits": 8, "num_leaves": 15}, x, y, 4)
+        assert not b._grower.int_scan
+    finally:
+        growmod.INT32_SCAN_ROWS = old
+    a = _train({"grad_quant_bits": 8, "num_leaves": 15}, x, y, 4)
+    assert a._grower.int_scan
+    for bst in (a, b):
+        for tree in bst.models:
+            nl = tree.num_leaves
+            assert int(np.sum(tree.leaf_count[:nl])) == 2000
+    # same data, same seeds: the two scans pick from identical exact
+    # integer histograms, differing only in representation at gain
+    # math — models agree on quality-level behaviour
+    assert len(a.models) == len(b.models)
+
+
 def test_quant_default_off_and_validation():
     x = np.random.default_rng(0).standard_normal((500, 4)) \
         .astype(np.float32)

@@ -1,9 +1,34 @@
 """Wave-stage planner (ops/stage_plan.py): cost model, plan derivation,
-byte-stable default, and the profile-guided install path."""
+byte-stable default, the profile-guided install path, and the on-disk
+plan store beside the persistent compile cache."""
+
+import contextlib
+import json
+import os
+import subprocess
+import sys
 
 import numpy as np
 
 from lightgbm_tpu.ops import stage_plan as sp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@contextlib.contextmanager
+def _plan_store(tmp_path):
+    """Point the compile cache (and thus the stage-plan store) at a tmp
+    dir, restoring the session-wide default afterwards."""
+    from lightgbm_tpu import compile_cache
+
+    prev = compile_cache.cache_dir()
+    compile_cache.configure(str(tmp_path / "cc"), _pin=False)
+    try:
+        yield
+    finally:
+        compile_cache.configure(
+            prev or os.path.expanduser("~/.cache/lgbm_tpu_xla"),
+            _pin=False)
 
 
 def test_legacy_plan_matches_historical_doubling():
@@ -74,6 +99,144 @@ def test_plan_digest_stable_and_cache_roundtrip():
     assert sp.cached_plan(sig) == [(4, 8), (128, None)]
 
 
+def test_derive_beats_legacy_gate():
+    """plan_beats prices candidate vs incumbent with the same wave-cost
+    function derive uses, requiring the 2% MIN_IMPROVEMENT margin —
+    the wave_plan=auto gate that keeps the byte-stable legacy ladder
+    on flat-cost shapes."""
+    legacy = sp.legacy_stage_plan(255, 128, 3)
+    # inverted measured curve (narrow waves cost MORE than the full
+    # width — a dispatch/tile floor): the single-stage plan's 8 waves
+    # at 100 ms beat the ladder's 7 narrow waves at 150 + 1 at 100
+    floor = {4: 150.0, 8: 150.0, 16: 150.0, 32: 150.0, 64: 150.0,
+             128: 100.0}
+    assert sp.plan_beats([(128, None)], legacy, 255, 3, 100.0, 1e-4,
+                         measured_ms=floor)
+    # perfectly flat curve: equal wave counts => equal cost => no 2%
+    # win, the incumbent survives (derive still picks fewer stages on
+    # ties, but auto keeps the byte-stable legacy ladder)
+    flat = {w: 100.0 for w in (4, 8, 16, 32, 64, 128)}
+    assert not sp.plan_beats([(128, None)], legacy, 255, 3, 100.0,
+                             1e-4, measured_ms=flat)
+    # column-dominated cost: the ladder is cheaper, a one-stage plan
+    # does NOT beat it
+    assert not sp.plan_beats([(128, None)], legacy, 255, 3, 1e-3, 1.0)
+    # a plan never beats itself (the margin requirement)
+    assert not sp.plan_beats(legacy, legacy, 255, 3, 10.0, 0.1)
+
+
+def test_plan_persistence_roundtrip(tmp_path):
+    """save_plan/load_plan round-trip beside the compile cache; corrupt
+    digests, foreign signatures and absent stores all degrade to None
+    (-> legacy plan), never to a bad plan."""
+    sig = ("persist-sig", 4096, 3, 64, False, "digest")
+    plan = [(4, 8), (16, 32), (128, None)]
+    with _plan_store(tmp_path):
+        assert sp.load_plan(sig) is None
+        path = sp.save_plan(sig, plan)
+        assert path is not None and os.path.exists(path)
+        assert sp.load_plan(sig) == plan
+        # cache_plan writes through to disk by default
+        sig2 = sig + ("v2",)
+        sp.cache_plan(sig2, plan)
+        assert sp.load_plan(sig2) == plan
+        # ... and persist=False keeps it process-local
+        sig3 = sig + ("v3",)
+        sp.cache_plan(sig3, plan, persist=False)
+        assert sp.load_plan(sig3) is None
+        # digest mismatch (hand-edited/corrupt file) -> fallback
+        with open(path) as fh:
+            payload = json.load(fh)
+        payload["plan"] = [[8, 16], [128, None]]     # digest now stale
+        with open(path, "w") as fh:
+            json.dump(payload, fh)
+        assert sp.load_plan(sig) is None
+        # signature mismatch (hash-prefix collision paranoia) -> None
+        sp.save_plan(sig, plan)
+        with open(path) as fh:
+            payload = json.load(fh)
+        payload["signature"] = "something else"
+        with open(path, "w") as fh:
+            json.dump(payload, fh)
+        assert sp.load_plan(sig) is None
+        # unparseable file -> None
+        with open(path, "w") as fh:
+            fh.write("{not json")
+        assert sp.load_plan(sig) is None
+        # forget_plan removes both layers
+        sp.save_plan(sig, plan)
+        sp.cache_plan(sig, plan, persist=False)
+        sp.forget_plan(sig)
+        assert sp.cached_plan(sig) is None
+        assert sp.load_plan(sig) is None
+    # no active store: save/load are clean no-ops
+    from lightgbm_tpu import compile_cache
+    if compile_cache.cache_dir() is None:
+        assert sp.save_plan(sig, plan) is None
+
+
+def test_auto_grower_adopts_persisted_plan(tmp_path):
+    """get_grower_programs under wave_plan=auto adopts a persisted plan
+    from a 'previous process' (plan_source='persisted'), and a corrupt
+    file falls back to the legacy plan."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.ops import grow
+
+    cfg = Config({"objective": "binary", "num_leaves": 31,
+                  "verbosity": -1, "seed": 424243})
+    sig = grow.programs_signature(4096, 3, 64, 3, False, cfg)
+    custom = [(8, 16), (31, None)]
+    with _plan_store(tmp_path):
+        sp.forget_plan(sig)
+        sp.save_plan(sig, custom)
+        progs = grow.get_grower_programs(4096, 3, 64, 3, False, cfg)
+        assert progs.stage_plan == custom
+        assert progs.plan_source == "persisted"
+        # corrupt the file: a FRESH signature lookup (cleared caches)
+        # degrades to the legacy default
+        sp.forget_plan(sig)
+        path = sp.save_plan(sig, custom)
+        with open(path, "w") as fh:
+            fh.write("garbage")
+        with grow._PROGRAM_CACHE_LOCK:
+            saved = dict(grow._PROGRAM_CACHE)
+            grow._PROGRAM_CACHE.clear()
+        try:
+            progs2 = grow.get_grower_programs(4096, 3, 64, 3, False, cfg)
+            assert progs2.plan_source == "default"
+            assert progs2.stage_plan == grow.default_stage_plan(4096,
+                                                                cfg)
+        finally:
+            with grow._PROGRAM_CACHE_LOCK:
+                grow._PROGRAM_CACHE.clear()
+                grow._PROGRAM_CACHE.update(saved)
+            sp.forget_plan(sig)
+
+
+def test_persisted_plan_key_stable_across_hashseeds(tmp_path):
+    """The on-disk plan filename must be PYTHONHASHSEED-independent —
+    a hash-order-dependent key would quietly defeat the cross-process
+    adoption (mirrors test_coldstart's programs_signature contract)."""
+    script = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from lightgbm_tpu import compile_cache
+from lightgbm_tpu.ops import stage_plan as sp
+compile_cache.configure({store!r}, _pin=False)
+sig = ("sig", 4096, 3, 64, False, "abc123")
+print(json.dumps({{"path": sp._plan_path(sig)}}))
+""".format(repo=REPO, store=str(tmp_path / "cc"))
+    outs = []
+    for seed in ("1", "271828"):
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": seed})
+        r = subprocess.run([sys.executable, "-c", script], env=env,
+                           capture_output=True, text=True, cwd=REPO)
+        assert r.returncode == 0, r.stderr[-2000:]
+        outs.append(json.loads(r.stdout.strip().splitlines()[-1]))
+    assert outs[0] == outs[1]
+
+
 def test_profile_stage_plan_records_and_installs():
     """End-to-end: probe timings land in obs, the derived plan installs
     on the grower, and a second same-signature grower picks it up from
@@ -101,7 +264,20 @@ def test_profile_stage_plan_records_and_installs():
     was_enabled = obs.enabled()
     obs.configure(enabled=True)
     try:
+        # a previous RUN of this test may have persisted a plan for
+        # this very signature beside the session compile cache (the
+        # profile path now writes through to disk): forget it so the
+        # probe actually measures, then rebuild from a clean slate
+        pre = build()
+        base_sig = pre._grower._base_signature
+        sp.forget_plan(base_sig)
+        from lightgbm_tpu.ops import grow as growmod
+        with growmod._PROGRAM_CACHE_LOCK:
+            for key in [k for k in growmod._PROGRAM_CACHE
+                        if k[:len(base_sig)] == base_sig]:
+                growmod._PROGRAM_CACHE.pop(key)
         b1 = build()
+        assert b1._grower.plan_source == "default"
         out = b1._grower.profile_stage_plan(reps=1)
         assert out["stage_ms"], out
         assert out["plan"][-1][1] is None
