@@ -374,7 +374,7 @@ class Booster:
                 res = feval(pred, self._train_set)
                 out.extend(_feval_records("training", res))
             if not is_train:
-                for i, v in enumerate(self._gbdt.valid_sets):
+                for v in self._gbdt.valid_sets:
                     pred = self._inner_eval_pred(v.score)
                     holder = Dataset.__new__(Dataset)
                     holder._handle = v.dataset

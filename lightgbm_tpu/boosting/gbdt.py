@@ -9,6 +9,7 @@ on-device tree traversal.  Model text format is the reference's "v2".
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -581,9 +582,10 @@ class GBDT:
             tree_idx = self.iter * self.num_model + k
             mask = self._grower.feature_mask_for(tree_idx)
             score, rec_i, rec_f, rec_c, nl, root_val, waves, qscale = \
-                self._dispatch_guard(lambda: self._grower.grow_one_iter(
-                    self.train_score[k], grad[k], hess[k], mask, shrink,
-                    row_mask, tree_idx=tree_idx))
+                self._dispatch_guard(functools.partial(
+                    self._grower.grow_one_iter, self.train_score[k],
+                    grad[k], hess[k], mask, shrink, row_mask,
+                    tree_idx=tree_idx))
             self.train_score = self.train_score.at[k].set(score)
             last_qscale = qscale
             self._wave_handles.append(waves)

@@ -64,6 +64,11 @@ class Ref:
 
 
 _last_error = ""
+# _last_error is process-global by C-API contract (LGBM_GetLastError);
+# the embed path and user threads can fail concurrently, so the write is
+# lock-guarded — a reader still sees whichever error landed last, but
+# never a torn interpreter state
+_ERROR_LOCK = threading.Lock()
 
 
 def LGBM_GetLastError() -> str:
@@ -78,7 +83,8 @@ def _api(fn):
             fn(*args, **kwargs)
             return 0
         except Exception as e:   # noqa: BLE001 — the C API catches all
-            _last_error = str(e)
+            with _ERROR_LOCK:
+                _last_error = str(e)
             return -1
     wrapper.__name__ = fn.__name__
     wrapper.__doc__ = fn.__doc__
@@ -703,6 +709,7 @@ def LGBM_WarmupServe(parameters, num_row, num_feature,
 # ---------------------------------------------------------------------------
 
 _network_conf = {"num_machines": 1, "rank": 0}
+_NETWORK_LOCK = threading.Lock()
 
 
 @_api
@@ -712,11 +719,13 @@ def LGBM_NetworkInit(machines, local_listen_port, listen_time_out,
     linkers are subsumed by ICI/`jax.distributed`); this records the
     topology request so ported clients keep working and multi-host
     configs route through `parallel.network`."""
-    _network_conf["num_machines"] = int(num_machines)
-    _network_conf["rank"] = 0
+    with _NETWORK_LOCK:
+        _network_conf["num_machines"] = int(num_machines)
+        _network_conf["rank"] = 0
 
 
 @_api
 def LGBM_NetworkFree():
-    _network_conf["num_machines"] = 1
-    _network_conf["rank"] = 0
+    with _NETWORK_LOCK:
+        _network_conf["num_machines"] = 1
+        _network_conf["rank"] = 0

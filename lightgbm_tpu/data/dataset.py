@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from .. import obs
 from ..config import Config
 from ..utils.log import LightGBMError, log_info, log_warning
 from ..utils.random import make_rng
@@ -263,7 +264,7 @@ class BinnedDataset:
         import jax
         import jax.numpy as jnp
         specs = []
-        for gid, group in enumerate(self.groups):
+        for group in self.groups:
             fspecs = []
             for sub, f in enumerate(group.feature_indices):
                 m = self.bin_mappers[f]
@@ -300,6 +301,7 @@ class BinnedDataset:
                 cols.append(col)
             return jnp.stack(cols, axis=1).astype(jnp.uint8)
 
+        build = obs.track_jit("dataset.build_binned", build)
         return build(data_dev)
 
     # -- CSR-native construction ------------------------------------------

@@ -39,7 +39,7 @@ def _sniff(lines: List[str]) -> str:
 
 def parse_libsvm(lines, num_features: Optional[int] = None):
     labels, rows, cols, vals = [], [], [], []
-    for i, line in enumerate(lines):
+    for line in lines:
         toks = line.split()
         if not toks:
             continue

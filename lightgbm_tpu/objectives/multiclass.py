@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs as _obs
 from ..utils.log import LightGBMError
 from .base import ObjectiveFunction
 from .binary import BinaryLogloss
@@ -52,6 +53,8 @@ class MulticlassSoftmax(ObjectiveFunction):
         if weights is not None:
             g, h = g * weights[None, :], h * weights[None, :]
         return g, h
+
+    _grad = _obs.track_jit("multiclass_grad", _grad)
 
     def get_gradients(self, scores):
         return self._grad(scores.astype(jnp.float32), self.label_int_d,

@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs as _obs
 from ..utils.log import LightGBMError, log_info
 from .base import ObjectiveFunction
 
@@ -40,6 +41,8 @@ class CrossEntropy(ObjectiveFunction):
         if weights is not None:
             g, h = g * weights, h * weights
         return g, h
+
+    _grad = _obs.track_jit("xentropy_grad", _grad)
 
     def get_gradients(self, scores):
         return self._grad(scores[0].astype(jnp.float32), self.label_d,
@@ -85,6 +88,8 @@ class CrossEntropyLambda(ObjectiveFunction):
         b = (c / jnp.maximum(d * d, K_EPSILON)) * (1.0 + w * epf - c)
         h = a * (1.0 + y * b)
         return g, h
+
+    _grad = _obs.track_jit("xentropy_lambda_grad", _grad)
 
     def get_gradients(self, scores):
         return self._grad(scores[0].astype(jnp.float32), self.label_d,

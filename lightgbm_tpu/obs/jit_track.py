@@ -17,10 +17,33 @@ one indirect call — no signature computation, no allocation.
 
 from __future__ import annotations
 
+import itertools
+import re
 import time
+import weakref
 from typing import Tuple
 
 from .state import STATE
+
+# plain objects (e.g. a static `self` of a jitted method) default to an
+# address-bearing repr; addresses get reused, so two distinct instances
+# could alias one signature and a real recompile would go unrecorded.
+# A weak per-object sequence number is collision-free and dies with the
+# object.
+_ADDR_REPR_RE = re.compile(r" at 0x[0-9a-fA-F]+>")
+_obj_seq = weakref.WeakKeyDictionary()
+_obj_counter = itertools.count()
+
+
+def _obj_token(leaf) -> str:
+    try:
+        seq = _obj_seq.get(leaf)
+        if seq is None:
+            seq = next(_obj_counter)
+            _obj_seq[leaf] = seq
+        return f"{type(leaf).__name__}#{seq}"
+    except TypeError:            # unhashable / not weak-referenceable
+        return repr(leaf)
 
 
 def _leaf_sig(leaf) -> str:
@@ -34,7 +57,8 @@ def _leaf_sig(leaf) -> str:
     if callable(leaf):
         return getattr(leaf, "__qualname__", None) \
             or getattr(leaf, "__name__", "<callable>")
-    return repr(leaf)
+    r = repr(leaf)
+    return _obj_token(leaf) if _ADDR_REPR_RE.search(r) else r
 
 
 def signature_of(args, kwargs, static_info: Tuple = ()) -> str:
@@ -84,6 +108,11 @@ class TrackedJit:
         # trace really happened — a cache warmed before tracking was
         # enabled (e.g. a disabled warm-up run on the same module-level
         # jit) must not count as a compile.
+        if len(self._seen) > 4096:
+            # unbounded instance churn (fresh static-self objectives per
+            # retrain window) must not grow this set forever; a clear
+            # costs at most one redundant recount per signature
+            self._seen.clear()
         self._seen.add(sig)
         before = self._cache_size()
         t0 = time.perf_counter()
@@ -100,6 +129,17 @@ class TrackedJit:
     # pass through jit-object attributes (lower, clear_cache, ...)
     def __getattr__(self, item):
         return getattr(self.fn, item)
+
+    # descriptor protocol: a TrackedJit wrapping a static-self jitted
+    # METHOD (`@functools.partial(jax.jit, static_argnums=0)`) must bind
+    # like the jit object it replaced, or `self._grad(...)` would drop
+    # the receiver.  Per-instance signatures are correct telemetry here:
+    # a static self really does recompile per instance.
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        import functools
+        return functools.partial(self, obj)
 
 
 def track_jit(name: str, fn, static_info: Tuple = ()) -> TrackedJit:

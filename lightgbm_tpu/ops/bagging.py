@@ -14,6 +14,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from .. import obs
+
 
 def bagging_partition(key, n_pad: int, num_data, fraction):
     """Returns (buffer (n_pad,) int32 with selected rows first, count)."""
@@ -39,6 +41,9 @@ def _bagging_impl(key, n_pad, num_data, fraction):
     sort_key = jnp.where(selected, 0, jnp.where(valid, 1, 2))
     order = jnp.argsort(sort_key.astype(jnp.int32), stable=True)
     return order.astype(jnp.int32), selected.sum().astype(jnp.int32)
+
+
+_bagging_impl = obs.track_jit("bagging_partition", _bagging_impl)
 
 
 def bagging_row_mask(seed, n_pad: int, num_data: int, fraction):
@@ -88,3 +93,6 @@ def goss_partition(key, grad_abs, n_pad, num_data, top_rate, other_rate):
     order = jnp.argsort(sort_key.astype(jnp.int32), stable=True)
     return (order.astype(jnp.int32), selected.sum().astype(jnp.int32),
             multiplier)
+
+
+goss_partition = obs.track_jit("goss_partition", goss_partition)

@@ -1450,6 +1450,7 @@ class DeviceGrower:
             return self.programs._wave_hist(binned, leaf, ghk, pend,
                                             scales if quant else None)
 
+        p_hist = obs.track_jit("grow.probe.hist", p_hist)
         int_scan = bool(self.int_scan)
 
         @jax.jit
@@ -1475,6 +1476,8 @@ class DeviceGrower:
                                                                    totals)
             return packed
 
+        p_find = obs.track_jit("grow.probe.find", p_find)
+
         @jax.jit
         def p_apply(binned_t, leaf, grp, thr, rdel):
             cols = jnp.take(binned_t, grp, axis=0).astype(jnp.int32)
@@ -1482,6 +1485,8 @@ class DeviceGrower:
                 & (cols > thr[:, None])
             return leaf + jnp.sum(mask * rdel[:, None], axis=0,
                                   dtype=jnp.int32)
+
+        p_apply = obs.track_jit("grow.probe.apply", p_apply)
 
         @jax.jit
         def p_score(score, leaf, vals):
@@ -1492,6 +1497,8 @@ class DeviceGrower:
             upd = jnp.einsum("nl,lk->nk", oh, jnp.stack([vhi, vlo], 1),
                              preferred_element_type=jnp.float32)
             return score + upd[:, 0] + upd[:, 1]
+
+        p_score = obs.track_jit("grow.probe.score", p_score)
 
         mask = jnp.ones((self.num_features,), bool)
         grp = jnp.asarray(rng.integers(0, self.num_groups, w, np.int32))
@@ -1507,6 +1514,8 @@ class DeviceGrower:
         @jax.jit
         def p_null(x):
             return x + 1.0
+
+        p_null = obs.track_jit("grow.probe.null", p_null)
 
         out = {}
         cases = {

@@ -23,6 +23,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from .. import obs
+
 # rows per scan chunk: 8 MXU passes of 1024x256 per group keeps VMEM happy
 _CHUNK = 8192
 
@@ -165,6 +167,9 @@ def _histogram_scan(bins: jnp.ndarray, gh: jnp.ndarray,
     return carry[0]
 
 
+_histogram_scan = obs.track_jit("histogram_scan", _histogram_scan)
+
+
 @functools.partial(jax.jit, donate_argnums=())
 def _gather_rows(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                  indices: jnp.ndarray, start: jnp.ndarray, count: jnp.ndarray):
@@ -205,11 +210,18 @@ def build_histogram(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     return _histogram_scan(bins, gh, num_chunks)
 
 
+_gather_rows = obs.track_jit("gather_rows", _gather_rows)
+
+
 @jax.jit
 def subtract_histogram(parent: jnp.ndarray, sibling: jnp.ndarray) -> jnp.ndarray:
     """Larger child = parent - smaller child (the reference's histogram
     subtraction trick, ``serial_tree_learner.cpp:508-513``)."""
     return parent - sibling
+
+
+subtract_histogram = obs.track_jit("subtract_histogram",
+                                   subtract_histogram)
 
 
 def bucket_size(count: int, minimum: int = 1024) -> int:

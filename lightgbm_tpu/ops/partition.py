@@ -25,6 +25,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from .. import obs
+
 
 @jax.jit
 def _partition_kernel(binned, indices, start, count, group, offset, width,
@@ -54,6 +56,10 @@ def _partition_kernel(binned, indices, start, count, group, offset, width,
                     jnp.where(valid, jnp.where(goes_left, 1, 2), 3))
     order = jnp.argsort(key.astype(jnp.int32), stable=True)
     return indices[order], (valid & goes_left).sum().astype(jnp.int32)
+
+
+_partition_kernel = obs.track_jit("partition_kernel",
+                                  _partition_kernel)
 
 
 def partition_leaf(binned, indices, count, *, group, offset, width,
@@ -90,6 +96,10 @@ def apply_leaf_outputs(score, indices, leaf_begin, leaf_values, valid_count):
     return score.at[indices].add(addend.astype(score.dtype))
 
 
+apply_leaf_outputs = obs.track_jit("apply_leaf_outputs",
+                                   apply_leaf_outputs)
+
+
 @jax.jit
 def goes_left_matrix(binned_rows, group, offset, width, default_bin, num_bin,
                      missing, threshold, default_left, is_cat, cat_member):
@@ -107,3 +117,6 @@ def goes_left_matrix(binned_rows, group, offset, width, default_bin, num_bin,
                          jnp.where(is_na, default_left, bin_ <= threshold))
     left_cat = cat_member[jnp.clip(bin_, 0, 255)]
     return jnp.where(is_cat, left_cat, left_num)
+
+
+goes_left_matrix = obs.track_jit("goes_left_matrix", goes_left_matrix)

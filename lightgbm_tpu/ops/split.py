@@ -51,6 +51,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
+
 K_EPSILON = 1e-15
 NEG_INF = -1e30
 
@@ -499,6 +501,9 @@ def _find_best_split(flat_hist, total, constraint, feature_mask,
                      meta: FeatureMeta, hp: SplitHyper, has_cat: bool):
     return find_best_split_impl(flat_hist, total, constraint, feature_mask,
                                 meta, hp, has_cat)
+
+
+_find_best_split = obs.track_jit("find_best_split", _find_best_split)
 
 
 class SplitContext:

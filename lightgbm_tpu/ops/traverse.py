@@ -134,6 +134,9 @@ def traverse(binned: jnp.ndarray, t: DeviceTree) -> jnp.ndarray:
     return ~leaf_code
 
 
+traverse = _obs.track_jit("traverse", traverse)
+
+
 @jax.jit
 def add_tree_score(score, binned, t: DeviceTree, multiplier):
     """score += multiplier * leaf_value[traverse(binned)]."""
@@ -149,3 +152,7 @@ add_tree_score = _obs.track_jit("add_tree_score", add_tree_score)
 @jax.jit
 def add_constant_score(score, value):
     return score + value
+
+
+add_constant_score = _obs.track_jit("add_constant_score",
+                                    add_constant_score)

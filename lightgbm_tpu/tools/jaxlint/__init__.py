@@ -2,12 +2,22 @@
 
 Rules (docs/StaticAnalysis.md has bad/good examples for each):
 
+Per-file rules:
+
 - **JL001** host-device sync inside hot-path loops
 - **JL002** recompile hazards around ``jax.jit``
 - **JL003** jitted callables not registered with ``obs.track_jit``
 - **JL004** float64 flowing into device code while x64 is disabled
 - **JL005** set iteration order leaking into output
 - **JL006** unguarded mutation of module-level state
+
+Cross-module dataflow rules (whole-repo symbol table + call graph,
+``project.py``):
+
+- **JL101** trace-key completeness around ``programs_signature``
+- **JL111** int8 quantization dtype-contract flow
+- **JL121** lock-order inversions and thread-shared state
+- **JL131** determinism taint into model/checkpoint/digest bytes
 
 CLI: ``python -m lightgbm_tpu.tools.jaxlint [paths] [--baseline ...]``.
 Inline suppression: ``# jaxlint: disable=JL001`` (same line) or

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import json
 from collections import Counter
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from .context import Finding
 
@@ -41,17 +41,24 @@ def load(path: str) -> Dict[Key, int]:
     return out
 
 
-def dump(findings: Sequence[Finding]) -> Dict:
+def dump(findings: Sequence[Finding],
+         extra: Optional[Dict[Key, int]] = None) -> Dict:
     counts = Counter(finding_key(f) for f in findings)
+    for k, n in (extra or {}).items():
+        counts[k] += n
     entries = [{"file": k[0], "rule": k[1], "snippet": k[2], "count": n}
                for k, n in sorted(counts.items())]
     return {"version": BASELINE_VERSION, "tool": "jaxlint",
             "entries": entries}
 
 
-def write(path: str, findings: Sequence[Finding]) -> None:
+def write(path: str, findings: Sequence[Finding],
+          extra: Optional[Dict[Key, int]] = None) -> None:
+    """Write ``findings`` (plus ``extra`` pre-counted entries — used by
+    ``--select --write-baseline`` to preserve unselected rules) as the
+    baseline."""
     with open(path, "w") as fh:
-        json.dump(dump(findings), fh, indent=1, sort_keys=False)
+        json.dump(dump(findings, extra), fh, indent=1, sort_keys=False)
         fh.write("\n")
 
 

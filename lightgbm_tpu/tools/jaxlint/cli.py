@@ -15,14 +15,15 @@ from typing import List, Optional
 
 from . import baseline as baseline_mod
 from .core import analyze_paths
-from .rules import RULE_DOCS
+from .rules import RULE_DOCS, RULE_EXPLAIN
 
 
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="jaxlint",
-        description="Repo-aware static analysis for host-sync, recompile "
-                    "and dtype hazards in JAX code.")
+        description="Repo-aware static analysis for host-sync, recompile, "
+                    "dtype, trace-key, lock-discipline and determinism "
+                    "hazards in JAX code.")
     p.add_argument("paths", nargs="*", default=["lightgbm_tpu"],
                    help="files/directories to analyze "
                         "(default: lightgbm_tpu)")
@@ -33,18 +34,28 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="ignore any baseline; report every finding")
     p.add_argument("--write-baseline", action="store_true",
                    help="write the current findings as the baseline and "
-                        "exit 0")
+                        "exit 0 (with --select, entries of unselected "
+                        "rules are preserved from the existing baseline)")
     p.add_argument("--select", default=None, metavar="CODES",
                    help="comma-separated rule codes to run "
-                        "(e.g. JL001,JL005)")
+                        "(e.g. JL001,JL005); the baseline is filtered "
+                        "to the selected rules")
     p.add_argument("--format", choices=("text", "json"), default="text")
     p.add_argument("--statistics", action="store_true",
                    help="print per-rule counts")
     p.add_argument("--list-rules", action="store_true",
                    help="list rule codes and exit")
+    p.add_argument("--explain", default=None, metavar="RULE",
+                   help="print a rule's full documentation and exit")
     p.add_argument("--root", default=None, metavar="DIR",
                    help="directory finding paths are reported relative "
                         "to (default: cwd)")
+    p.add_argument("--cache-dir", default=None, metavar="DIR",
+                   help="incremental cache directory (content-hash "
+                        "keyed; unchanged files/tree replay without "
+                        "re-analysis).  CI uses .jaxlint_cache")
+    p.add_argument("--no-cache", action="store_true",
+                   help="ignore --cache-dir; always analyze cold")
     return p
 
 
@@ -56,6 +67,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"{code}  {doc}")
         return 0
 
+    if args.explain:
+        code = args.explain.strip().upper()
+        doc = RULE_EXPLAIN.get(code)
+        if doc is None:
+            print(f"jaxlint: unknown rule {code!r} (see --list-rules)",
+                  file=sys.stderr)
+            return 2
+        print(f"{code} — {RULE_DOCS[code]}\n\n{doc}")
+        return 0
+
     select = None
     if args.select:
         select = {c.strip().upper() for c in args.select.split(",")}
@@ -65,7 +86,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr)
             return 2
 
-    result = analyze_paths(args.paths, root=args.root, select=select)
+    cache_dir = None if args.no_cache else args.cache_dir
+    result = analyze_paths(args.paths, root=args.root, select=select,
+                           cache_dir=cache_dir)
     if result.errors:
         for path, msg in result.errors:
             print(f"{path}: error: {msg}", file=sys.stderr)
@@ -80,18 +103,27 @@ def main(argv: Optional[List[str]] = None) -> int:
             baseline_path = default
 
     if args.write_baseline:
-        if select is not None:
-            # a rule-filtered run only holds the selected findings;
-            # writing it would silently drop every other accepted entry
-            print("jaxlint: --write-baseline cannot be combined with "
-                  "--select (it would erase the other rules' baseline "
-                  "entries); run without --select", file=sys.stderr)
-            return 2
         out = baseline_path or (
             os.path.join(args.root, baseline_mod.DEFAULT_BASELINE)
             if args.root else baseline_mod.DEFAULT_BASELINE)
-        baseline_mod.write(out, result.findings)
-        print(f"jaxlint: wrote {len(result.findings)} finding(s) to {out}")
+        preserved = {}
+        if select is not None and os.path.exists(out):
+            # a rule-filtered run only holds the selected findings:
+            # carry every other rule's accepted entries over unchanged
+            # instead of silently erasing them
+            try:
+                loaded = baseline_mod.load(out)
+            except (OSError, ValueError, KeyError) as e:
+                print(f"jaxlint: cannot read baseline {out}: {e}",
+                      file=sys.stderr)
+                return 2
+            preserved = {k: n for k, n in loaded.items()
+                         if k[1] not in select}
+        baseline_mod.write(out, result.findings, extra=preserved)
+        kept = f" (+{sum(preserved.values())} preserved)" \
+            if preserved else ""
+        print(f"jaxlint: wrote {len(result.findings)} finding(s){kept} "
+              f"to {out}")
         return 0
 
     accepted = {}
@@ -102,6 +134,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"jaxlint: cannot read baseline {baseline_path}: {e}",
                   file=sys.stderr)
             return 2
+        if select is not None:
+            # a filtered run must only be judged against the selected
+            # rules' entries — the others would all read as stale
+            accepted = {k: n for k, n in accepted.items()
+                        if k[1] in select}
     new, stale = baseline_mod.apply(result.findings, accepted)
 
     if args.format == "json":
@@ -111,6 +148,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             "new": [f.to_dict() for f in new],
             "baselined": len(result.findings) - len(new),
             "suppressed": len(result.suppressed),
+            "cache": {"hits": result.cache_hits,
+                      "misses": result.cache_misses,
+                      "warm": result.from_cache},
             "stale_baseline_entries": [
                 {"file": k[0], "rule": k[1], "snippet": k[2], "count": n}
                 for k, n in stale],
@@ -126,6 +166,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                    f"{len(result.findings)} finding(s): {len(new)} new, "
                    f"{len(result.findings) - len(new)} baselined, "
                    f"{len(result.suppressed)} suppressed")
+        if cache_dir is not None:
+            summary += (" [cache: warm]" if result.from_cache else
+                        f" [cache: {result.cache_hits} hit(s), "
+                        f"{result.cache_misses} miss(es)]")
         if stale:
             summary += (f"; {sum(n for _, n in stale)} stale baseline "
                         "entr(ies) — regenerate with --write-baseline")

@@ -1,0 +1,493 @@
+"""Whole-repo analysis context: symbol table, import and call graphs.
+
+PR 2's jaxlint was strictly per-file, but the repo's load-bearing
+contracts are cross-module: a constant defined in ``ops/histogram.py``
+shapes a trace built in ``ops/grow.py``; a lock acquired in
+``serve/engine.py`` is ordered against one in ``robust/retry.py``; a
+wall-clock read in one function reaches a checkpoint writer three calls
+away.  :class:`ProjectContext` builds the shared machinery the JL1xx
+rule families need on top of the per-file :class:`FileContext`s:
+
+* a **module table** keyed by dotted module name (derived from the
+  relative path), with each module's top-level constants, functions,
+  classes/methods and import-alias table (relative imports resolved);
+* a **call graph** over ``(module, qualname)`` function keys, resolving
+  bare names, ``self.method``, imported modules/symbols, and locals
+  assigned from project-class constructors;
+* the **traced-region set**: functions whose bodies run under a jax
+  trace (jit-decorated/bound, passed to ``lax.scan``-family combinators,
+  nested inside either) closed transitively over the call graph;
+* **reachability** helpers used by the lock-discipline and determinism
+  rules.
+
+Like the per-file layer, everything here is pure ``ast`` — analyzed
+code is never imported.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .context import FileContext, dotted_name
+
+#: lax combinators whose callable arguments run inside a trace
+_TRACE_COMBINATORS = ("scan", "cond", "while_loop", "fori_loop", "switch",
+                      "map", "vmap", "pmap", "remat", "checkpoint", "jit",
+                      "custom_jvp", "custom_vjp")
+
+FuncKey = Tuple[str, str]          # (module dotted name, qualname)
+
+
+class FuncInfo:
+    """One function or method in the project."""
+
+    __slots__ = ("module", "qualname", "node", "class_name")
+
+    def __init__(self, module: str, qualname: str,
+                 node: ast.AST, class_name: Optional[str]):
+        self.module = module
+        self.qualname = qualname
+        self.node = node
+        self.class_name = class_name
+
+    @property
+    def key(self) -> FuncKey:
+        return (self.module, self.qualname)
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+
+class ModuleInfo:
+    """Symbol table of one analyzed module."""
+
+    def __init__(self, name: str, ctx: FileContext):
+        self.name = name
+        self.ctx = ctx
+        #: top-level NAME = <expr> assignments (constants, jit bindings)
+        self.assigns: Dict[str, ast.AST] = {}
+        #: local alias -> (module dotted name, symbol-or-None)
+        self.imports: Dict[str, Tuple[str, Optional[str]]] = {}
+        self.classes: Dict[str, ast.ClassDef] = {}
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        self.assigns[t.id] = stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None \
+                    and isinstance(stmt.target, ast.Name):
+                self.assigns[stmt.target.id] = stmt.value
+            elif isinstance(stmt, ast.ClassDef):
+                self.classes[stmt.name] = stmt
+        self._collect_imports()
+
+    def _collect_imports(self):
+        pkg_parts = self.name.split(".")[:-1]
+        for node in ast.walk(self.ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.imports[a.asname or a.name.split(".")[0]] = \
+                        (a.name, None)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg_parts[:len(pkg_parts) - (node.level - 1)]
+                    mod = ".".join(base + ([node.module]
+                                           if node.module else []))
+                else:
+                    mod = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.imports[a.asname or a.name] = (mod, a.name)
+
+
+def module_name_for(relpath: str) -> str:
+    p = relpath.replace("\\", "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    if p.endswith("/__init__"):
+        p = p[:-len("/__init__")]
+    return p.replace("/", ".")
+
+
+class ProjectContext:
+    """Cross-module view over a set of :class:`FileContext`s."""
+
+    def __init__(self, contexts: Iterable[FileContext]):
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.ctx_for: Dict[str, FileContext] = {}
+        for ctx in contexts:
+            name = module_name_for(ctx.relpath)
+            self.modules[name] = ModuleInfo(name, ctx)
+            self.ctx_for[name] = ctx
+        self.functions: Dict[FuncKey, FuncInfo] = {}
+        self._collect_functions()
+        #: function key -> resolved callee keys
+        self.calls: Dict[FuncKey, Set[FuncKey]] = {}
+        #: per function: locals assigned from project-class constructors,
+        #: plus self-attrs assigned that way anywhere in the class
+        self._instance_types: Dict[FuncKey, Dict[str, Tuple[str, str]]] = {}
+        self._self_attr_types: Dict[Tuple[str, str],
+                                    Dict[str, Tuple[str, str]]] = {}
+        self._collect_instance_types()
+        self._build_call_graph()
+        self.jit_bound: Set[FuncKey] = set()
+        #: attribute / top-level names bound to jit callables, per module
+        self.jit_bound_names: Dict[str, Set[str]] = {}
+        self._collect_jit_bindings()
+        self.traced: Set[FuncKey] = self._traced_closure()
+
+    # ------------------------------------------------------------------
+    def _collect_functions(self):
+        self._node_func: Dict[int, FuncInfo] = {}
+
+        def visit(mname, body, prefix: str, class_name: Optional[str]):
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    q = f"{prefix}{stmt.name}"
+                    fi = FuncInfo(mname, q, stmt, class_name)
+                    self.functions[(mname, q)] = fi
+                    self._node_func[id(stmt)] = fi
+                    visit(mname, stmt.body, f"{q}.<locals>.", class_name)
+                elif isinstance(stmt, ast.ClassDef):
+                    visit(mname, stmt.body, f"{stmt.name}.", stmt.name)
+
+        for mname, mod in self.modules.items():
+            visit(mname, mod.ctx.tree.body, "", None)
+
+    def enclosing_function(self, module: str, node: ast.AST) \
+            -> Optional[FuncInfo]:
+        ctx = self.ctx_for.get(module)
+        if ctx is None:
+            return None
+        chain: List[ast.AST] = [node] + list(ctx.ancestors(node))
+        for n in chain:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return self._node_func.get(id(n))
+        return None
+
+    # ------------------------------------------------------------------
+    def resolve_module(self, module: str, alias: str) -> Optional[str]:
+        """Project module a bare name refers to in ``module``, if any."""
+        mod = self.modules.get(module)
+        if mod is None:
+            return None
+        tgt = mod.imports.get(alias)
+        if tgt is None:
+            return None
+        full, sym = tgt
+        if sym is None:
+            return full if full in self.modules else None
+        # `from pkg import mod` where pkg.mod is a project module
+        cand = f"{full}.{sym}" if full else sym
+        return cand if cand in self.modules else None
+
+    def resolve_symbol(self, module: str, name: str) \
+            -> Optional[Tuple[str, str]]:
+        """(defining module, symbol) for a bare name used in ``module``."""
+        mod = self.modules.get(module)
+        if mod is None:
+            return None
+        if name in mod.assigns or (module, name) in self.functions \
+                or name in mod.classes:
+            return (module, name)
+        tgt = mod.imports.get(name)
+        if tgt is not None:
+            full, sym = tgt
+            if sym is not None and full in self.modules:
+                return (full, sym)
+        return None
+
+    def constant_value_node(self, module: str, name: str) \
+            -> Optional[ast.AST]:
+        """Top-level value expression of a (possibly imported) constant."""
+        resolved = self.resolve_symbol(module, name)
+        if resolved is None:
+            return None
+        dmod, sym = resolved
+        return self.modules[dmod].assigns.get(sym)
+
+    # ------------------------------------------------------------------
+    def _collect_instance_types(self):
+        """Map locals / self-attrs assigned from project-class calls."""
+        for key, fi in self.functions.items():
+            local: Dict[str, Tuple[str, str]] = {}
+            for node in ast.walk(fi.node):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                cls = self._class_of_call(fi.module, node.value)
+                if cls is None:
+                    continue
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    local[t.id] = cls
+                elif isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self" and fi.class_name:
+                    self._self_attr_types.setdefault(
+                        (fi.module, fi.class_name), {})[t.attr] = cls
+            self._instance_types[key] = local
+
+    def _class_of_call(self, module: str, call: ast.Call) \
+            -> Optional[Tuple[str, str]]:
+        d = dotted_name(call.func)
+        if d is None:
+            return None
+        parts = d.split(".")
+        if len(parts) == 1:
+            r = self.resolve_symbol(module, parts[0])
+            if r is not None and parts[0][:1].isupper() \
+                    and r[1] in self.modules[r[0]].classes:
+                return r
+        elif len(parts) == 2:
+            m2 = self.resolve_module(module, parts[0])
+            if m2 is not None and parts[1] in self.modules[m2].classes:
+                return (m2, parts[1])
+        return None
+
+    # ------------------------------------------------------------------
+    def resolve_call(self, fi: FuncInfo, call: ast.Call) -> Set[FuncKey]:
+        """Project functions a call site may invoke (best effort)."""
+        out: Set[FuncKey] = set()
+        func = call.func
+        if isinstance(func, ast.Name):
+            r = self.resolve_symbol(fi.module, func.id)
+            if r is not None:
+                if r in self.functions:
+                    out.add(r)
+                elif r[1] in self.modules[r[0]].classes:
+                    init = (r[0], f"{r[1]}.__init__")
+                    if init in self.functions:
+                        out.add(init)
+            # nested function defined in an enclosing scope
+            for k in ((fi.module, f"{fi.qualname}.<locals>.{func.id}"),):
+                if k in self.functions:
+                    out.add(k)
+            return out
+        d = dotted_name(func)
+        if d is None:
+            return out
+        parts = d.split(".")
+        if parts[0] == "self" and fi.class_name is not None \
+                and len(parts) == 2:
+            k = (fi.module, f"{fi.class_name}.{parts[1]}")
+            if k in self.functions:
+                out.add(k)
+            return out
+        if parts[0] == "self" and fi.class_name is not None \
+                and len(parts) == 3:
+            # self.<attr>.<meth> where attr's class is known
+            attrs = self._self_attr_types.get((fi.module, fi.class_name),
+                                              {})
+            cls = attrs.get(parts[1])
+            if cls is not None:
+                k = (cls[0], f"{cls[1]}.{parts[2]}")
+                if k in self.functions:
+                    out.add(k)
+            return out
+        if len(parts) == 2:
+            # local var of a known project class
+            cls = self._instance_types.get(fi.key, {}).get(parts[0])
+            if cls is not None:
+                k = (cls[0], f"{cls[1]}.{parts[1]}")
+                if k in self.functions:
+                    out.add(k)
+                return out
+            m2 = self.resolve_module(fi.module, parts[0])
+            if m2 is not None:
+                k = (m2, parts[1])
+                if k in self.functions:
+                    out.add(k)
+                elif parts[1] in self.modules[m2].classes:
+                    init = (m2, f"{parts[1]}.__init__")
+                    if init in self.functions:
+                        out.add(init)
+            return out
+        return out
+
+    def _build_call_graph(self):
+        for key, fi in self.functions.items():
+            callees: Set[FuncKey] = set()
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Call):
+                    sub = self.enclosing_function(fi.module, node)
+                    if sub is not None and sub.key != key:
+                        continue        # belongs to a nested function
+                    callees |= self.resolve_call(fi, node)
+            self.calls[key] = callees
+
+    def reachable_from(self, roots: Iterable[FuncKey]) -> Set[FuncKey]:
+        seen: Set[FuncKey] = set()
+        stack = [r for r in roots if r in self.functions]
+        while stack:
+            k = stack.pop()
+            if k in seen:
+                continue
+            seen.add(k)
+            stack.extend(self.calls.get(k, ()))
+        return seen
+
+    # ------------------------------------------------------------------
+    def _collect_jit_bindings(self):
+        """Functions and names bound to jitted callables, plus functions
+        handed to trace combinators."""
+        direct: Set[FuncKey] = set()
+        for mname, mod in self.modules.items():
+            ctx = mod.ctx
+            names = self.jit_bound_names.setdefault(mname, set())
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        if ctx.jit_decorator_statics(dec) is not None:
+                            fi = self._func_by_node(mname, node)
+                            if fi is not None:
+                                direct.add(fi.key)
+                            names.add(node.name)
+                elif isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1:
+                    tgt = dotted_name(node.targets[0])
+                    if tgt is None:
+                        continue
+                    for jc in self._jit_payloads(ctx, node.value):
+                        names.add(tgt.rsplit(".", 1)[-1])
+                        for a in ast.walk(jc):
+                            r = self._callable_ref(mname, ctx, a)
+                            if r is not None:
+                                direct.add(r)
+                elif isinstance(node, ast.Call):
+                    d = dotted_name(node.func)
+                    if d is not None \
+                            and d.split(".")[-1] in _TRACE_COMBINATORS:
+                        for arg in node.args[:2]:
+                            r = self._callable_ref(mname, ctx, arg)
+                            if r is not None:
+                                direct.add(r)
+        self.jit_bound = direct
+
+    def _jit_payloads(self, ctx: FileContext, value: ast.AST) -> list:
+        """jit-call nodes inside an assigned value (handles
+        ``obs.track_jit("n", jax.jit(f))`` and plain ``jax.jit(f)``)."""
+        out = []
+        for n in ast.walk(value):
+            if ctx.is_jit_call(n):
+                out.append(n)
+        d = dotted_name(value.func) if isinstance(value, ast.Call) else None
+        if d is not None and d.split(".")[-1] == "track_jit" \
+                and not out and len(value.args) >= 2:
+            # track_jit("name", already_jitted_fn): the rebound callable
+            out.append(value)
+        return out
+
+    def _callable_ref(self, module: str, ctx: FileContext,
+                      node: ast.AST) -> Optional[FuncKey]:
+        """FuncKey a Name/Attribute/partial argument refers to."""
+        if isinstance(node, ast.Call):
+            d = dotted_name(node.func)
+            if d is not None and d.split(".")[-1] == "partial" \
+                    and node.args:
+                return self._callable_ref(module, ctx, node.args[0])
+            return None
+        d = dotted_name(node)
+        if d is None:
+            return None
+        parts = d.split(".")
+        if parts[0] == "self" and len(parts) == 2:
+            ctx2 = self.ctx_for.get(module)
+            fi0 = self.enclosing_function(module, node) \
+                if ctx2 is not None else None
+            if fi0 is not None and fi0.class_name is not None:
+                k = (module, f"{fi0.class_name}.{parts[1]}")
+                if k in self.functions:
+                    return k
+            for fi in self.functions.values():
+                if fi.module == module and fi.name == parts[1] \
+                        and fi.class_name is not None:
+                    return fi.key
+            return None
+        if len(parts) == 1:
+            # a nested def referenced from its enclosing scope
+            fi0 = self.enclosing_function(module, node)
+            while fi0 is not None:
+                k = (module, f"{fi0.qualname}.<locals>.{parts[0]}")
+                if k in self.functions:
+                    return k
+                up = fi0.qualname.rsplit(".<locals>.", 1)
+                fi0 = self.functions.get((module, up[0])) \
+                    if len(up) == 2 else None
+        r = self.resolve_symbol(module, parts[0])
+        if r is None:
+            return None
+        if len(parts) == 1:
+            return r if r in self.functions else None
+        k = (r[0], ".".join([r[1]] + parts[1:])) \
+            if r[1] not in self.modules else None
+        return k if k in self.functions else None
+
+    def _func_by_node(self, module: str, node: ast.AST) \
+            -> Optional[FuncInfo]:
+        return self._node_func.get(id(node))
+
+    def _traced_closure(self) -> Set[FuncKey]:
+        """jit-bound / combinator-fed functions, their nested defs, and
+        everything they (transitively) call."""
+        roots: Set[FuncKey] = set(self.jit_bound)
+        # nested defs inside a traced function body are traced too
+        for key in list(roots):
+            prefix = key[1] + ".<locals>."
+            for k2 in self.functions:
+                if k2[0] == key[0] and k2[1].startswith(prefix):
+                    roots.add(k2)
+        return self.reachable_from(roots)
+
+    def is_traced_node(self, module: str, node: ast.AST) -> bool:
+        fi = self.enclosing_function(module, node)
+        return fi is not None and fi.key in self.traced
+
+    # ------------------------------------------------------------------
+    def own_nodes(self, fi: FuncInfo) -> List[ast.AST]:
+        """Nodes lexically inside ``fi`` but NOT inside a nested
+        function — each function's own scope, computed once per module
+        with a single DFS (the taint fixpoint re-reads these a lot)."""
+        if not hasattr(self, "_scope_nodes"):
+            self._scope_nodes: Dict[FuncKey, List[ast.AST]] = {}
+            self._module_nodes: Dict[str, List[ast.AST]] = {}
+            for mname, mod in self.modules.items():
+                top: List[ast.AST] = []
+                self._module_nodes[mname] = top
+
+                def dfs(node, owner_key):
+                    for child in ast.iter_child_nodes(node):
+                        child_owner = owner_key
+                        if isinstance(child, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef)):
+                            fi2 = self._node_func.get(id(child))
+                            child_owner = fi2.key if fi2 is not None \
+                                else owner_key
+                        if child_owner is None:
+                            top.append(child)
+                        else:
+                            self._scope_nodes.setdefault(
+                                child_owner, []).append(child)
+                        dfs(child, child_owner)
+                dfs(mod.ctx.tree, None)
+        return self._scope_nodes.get(fi.key, [])
+
+    def module_level_nodes(self, module: str) -> List[ast.AST]:
+        """Nodes outside any function in ``module`` (class bodies
+        included)."""
+        if not hasattr(self, "_module_nodes"):
+            for fi in self.functions.values():
+                self.own_nodes(fi)
+                break
+            if not hasattr(self, "_module_nodes"):
+                self._module_nodes = {}
+                for mname, mod in self.modules.items():
+                    self._module_nodes[mname] = [
+                        n for n in ast.walk(mod.ctx.tree)
+                        if self.enclosing_function(mname, n) is None]
+        return self._module_nodes.get(module, [])

@@ -1,20 +1,33 @@
-"""Rule registry: JLxxx code -> (checker, one-line description).
+"""Rule registry: JLxxx code -> rule module.
 
-Each rule module exposes ``CODE``, ``SHORT`` and ``check(ctx)`` yielding
-:class:`~..context.Finding` objects.  Registration is explicit (no
+Each rule module exposes ``CODE``, ``SHORT`` and either ``check(ctx)``
+(per-file, JL0xx) or ``check_project(project)`` with ``PROJECT_RULE =
+True`` (cross-module dataflow, JL1xx).  Registration is explicit (no
 import-time magic) so the set of shipped rules is grep-able here.
 """
 
 from __future__ import annotations
 
-from . import (dtype_drift, global_state, host_sync, jit_registry,
-               recompile, set_order)
+from . import (determinism, dtype_drift, dtype_flow, global_state,
+               host_sync, jit_registry, lock_order, recompile, set_order,
+               trace_key)
 
 _MODULES = (host_sync, recompile, jit_registry, dtype_drift, set_order,
-            global_state)
+            global_state, trace_key, dtype_flow, lock_order, determinism)
 
 #: code -> rule module, in code order
 RULES = {m.CODE: m for m in _MODULES}
 
+#: code -> per-file rule module (checked one file at a time)
+FILE_RULES = {c: m for c, m in RULES.items()
+              if not getattr(m, "PROJECT_RULE", False)}
+
+#: code -> project rule module (needs the whole-repo symbol table)
+PROJECT_RULES = {c: m for c, m in RULES.items()
+                 if getattr(m, "PROJECT_RULE", False)}
+
 #: code -> one-line description (CLI --list-rules, docs)
 RULE_DOCS = {m.CODE: m.SHORT for m in _MODULES}
+
+#: code -> full rule documentation (CLI --explain)
+RULE_EXPLAIN = {m.CODE: (m.__doc__ or m.SHORT).strip() for m in _MODULES}

@@ -1,0 +1,311 @@
+"""JL111 — int8/int32 quantization dtype contract, project-wide.
+
+The ``grad_quant_bits=8`` path is only fast (and only byte-stable)
+while the data stays integer from quantization to the single dequantize
+point in the gain/leaf-value math: int8 stat columns contract on the
+MXU's native int8→int32 path, histogram state accumulates in int32, and
+ONE ``.astype(float32) * scale`` dequantize ends the integer region.
+PR 9's review found exactly the violations this rule now automates: an
+f32 dequantize left upstream of the find-best scan, and int8 dots
+without ``preferred_element_type`` (which silently accumulate through
+f32 and fall off the MXU int path).  Per-function dtype dataflow
+(tracking ``astype``/``asarray``/constructor dtypes and contraction
+result types) drives three checks:
+
+1. **int8 contraction without ``preferred_element_type``**: any
+   ``einsum``/``dot``/``matmul``/``tensordot``/``dot_general`` whose
+   operand is int8-typed must pin the int32 accumulator.
+2. **Premature f32 upcast**: ``.astype(float32)`` on an int8 value, or
+   on int32 *quantized accumulation state* (the result of an int32-
+   accumulated contraction and values derived from it), is flagged —
+   UNLESS it is the sanctioned dequantize idiom, an immediate multiply
+   or divide by a ``*scale*``-named value, or lives in a function whose
+   name mentions ``dequant``.
+3. **Cross-module f64 leakage** (the repo runs with x64 disabled): a
+   module-level constant whose value is float64-marked (``np.float64``,
+   ``dtype="float64"``) passed into a ``jnp.``-rooted call — including
+   constants imported from another module, which the per-file JL004
+   cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional
+
+from ..context import FileContext, chain_root, dotted_name
+from ..project import ProjectContext
+
+CODE = "JL111"
+SHORT = ("int8 dtype-contract break: unpinned int8 contraction, "
+         "premature f32 upcast of quantized state, or cross-module "
+         "f64 into jnp under disabled x64")
+
+PROJECT_RULE = True
+
+_CONTRACTIONS = ("einsum", "dot", "matmul", "tensordot", "dot_general")
+_INT8 = "int8"
+_INT32Q = "int32q"          # int32 quantized accumulation state
+_F32_NAMES = ("float32", "f32")
+_SCALE_HINT = ("scale", "qscale", "dequant")
+
+
+def _dtype_of_node(ctx: FileContext, node: ast.AST) -> Optional[str]:
+    """Literal dtype a dtype-expression denotes ("int8", "float32"...)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Attribute):
+        root = chain_root(node)
+        if root in ctx.jnp_aliases or root in ctx.numpy_aliases \
+                or root in ctx.jax_aliases:
+            return node.attr
+    return None
+
+
+class _Env:
+    """Per-scope inferred dtypes, line-aware: each name maps to its
+    binding history so a use at line L sees the binding in effect
+    BEFORE L (``m8 = m8.astype(jnp.float32)`` must see the int8 `m8`
+    on its right-hand side, not its own result)."""
+
+    def __init__(self):
+        self.bindings: Dict[str, List[Tuple[int, str]]] = {}
+
+    def bind(self, name: str, line: int, dtype: str) -> None:
+        self.bindings.setdefault(name, []).append((line, dtype))
+
+    def get(self, name: str, line: int) -> Optional[str]:
+        best = None
+        for bl, dt in self.bindings.get(name, ()):
+            if bl < line:
+                best = dt
+        return best
+
+
+def _infer(ctx: FileContext, env: _Env, node: ast.AST,
+           line: int) -> Optional[str]:
+    """Dtype tag of an expression evaluated at ``line``, or None."""
+    if isinstance(node, ast.Name):
+        return env.get(node.id, line)
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return env.get(f"self.{node.attr}", line)
+    if isinstance(node, ast.Subscript):
+        return _infer(ctx, env, node.value, line)
+    if isinstance(node, ast.BinOp):
+        lt = _infer(ctx, env, node.left, line)
+        rt = _infer(ctx, env, node.right, line)
+        if lt == rt:
+            return lt
+        pair = {lt, rt}
+        if pair == {_INT8, _INT32Q}:
+            return _INT32Q
+        if None in pair:
+            t = lt or rt
+            # int arithmetic with an unknown (likely scalar) operand
+            # keeps the known integer tag; anything else is unknown
+            return t if t in (_INT8, _INT32Q) else None
+        return None
+    if isinstance(node, ast.UnaryOp):
+        return _infer(ctx, env, node.operand, line)
+    if isinstance(node, ast.Call):
+        return _call_dtype(ctx, env, node, line)
+    return None
+
+
+def _call_dtype(ctx: FileContext, env: _Env, node: ast.Call,
+                line: int) -> Optional[str]:
+    func = node.func
+    # x.astype(D) / x.reshape / x.transpose / dtype-preserving methods
+    if isinstance(func, ast.Attribute):
+        if func.attr == "astype" and node.args:
+            return _dtype_of_node(ctx, node.args[0])
+        if func.attr in ("reshape", "transpose", "sum", "cumsum", "at",
+                         "set", "add", "squeeze", "ravel", "flatten"):
+            base = _infer(ctx, env, func.value, line)
+            if base in (_INT8, _INT32Q):
+                # integer sums stay integer; .at[...].set/add preserve
+                return _INT32Q if func.attr in ("sum", "cumsum") \
+                    and base == _INT8 else base
+            return base
+        d = dotted_name(func)
+        if d is not None:
+            tail = d.split(".")[-1]
+            root = chain_root(func)
+            if tail in ("int8",) and (root in ctx.jnp_aliases
+                                      or root in ctx.numpy_aliases):
+                return _INT8
+            if tail in _CONTRACTIONS:
+                pet = _pet_dtype(ctx, node)
+                if pet is not None:
+                    return _INT32Q if "int32" in pet else pet
+                ops = [_infer(ctx, env, a, line) for a in node.args]
+                if _INT8 in ops:
+                    return _INT8
+                return None
+            if tail in ("zeros", "ones", "full", "empty", "arange",
+                        "asarray", "array"):
+                for kw in node.keywords:
+                    if kw.arg == "dtype":
+                        return _dtype_of_node(ctx, kw.value)
+                for a in node.args[1:]:
+                    dt = _dtype_of_node(ctx, a)
+                    if dt is not None:
+                        return dt
+                return None
+            if tail == "where" and len(node.args) == 3:
+                a = _infer(ctx, env, node.args[1], line)
+                b = _infer(ctx, env, node.args[2], line)
+                return a if a == b else None
+            if tail == "convert_element_type" and len(node.args) >= 2:
+                return _dtype_of_node(ctx, node.args[1])
+    return None
+
+
+def _pet_dtype(ctx: FileContext, node: ast.Call) -> Optional[str]:
+    for kw in node.keywords:
+        if kw.arg == "preferred_element_type":
+            return _dtype_of_node(ctx, kw.value) or "unknown"
+    return None
+
+
+def _scale_multiplied(ctx: FileContext, node: ast.AST) -> bool:
+    """True when the astype(...) result is immediately multiplied or
+    divided by a value whose source text mentions a scale — the
+    sanctioned dequantize idiom."""
+    parent = ctx.parent(node)
+    if not (isinstance(parent, ast.BinOp)
+            and isinstance(parent.op, (ast.Mult, ast.Div))):
+        return False
+    other = parent.right if parent.left is node else parent.left
+    try:
+        text = ast.unparse(other).lower()
+    except Exception:
+        return False
+    return any(h in text for h in _SCALE_HINT)
+
+
+def _scope_walk(root: ast.AST):
+    """Walk ``root`` without descending into nested function scopes
+    (class bodies are transparent), so each scope is analyzed exactly
+    once with its own dtype state."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _check_function(ctx: FileContext, fn_name: str, body: ast.AST):
+    """Run the int8 checks over one scope with fresh dtype state."""
+    env = _Env()
+    # statement-order pass: the walk is not source-ordered, so collect
+    # assignments first by line order for a stable single pass
+    assigns = [n for n in _scope_walk(body) if isinstance(n, ast.Assign)
+               and len(n.targets) == 1]
+    assigns.sort(key=lambda n: (n.lineno, n.col_offset))
+    for a in assigns:
+        t = a.targets[0]
+        dt = _infer(ctx, env, a.value, a.lineno)
+        if dt is None:
+            continue
+        if isinstance(t, ast.Name):
+            env.bind(t.id, a.lineno, dt)
+        elif isinstance(t, ast.Attribute) \
+                and isinstance(t.value, ast.Name) \
+                and t.value.id == "self":
+            env.bind(f"self.{t.attr}", a.lineno, dt)
+
+    dequant_fn = "dequant" in fn_name.lower()
+    for node in _scope_walk(body):
+        if not isinstance(node, ast.Call):
+            continue
+        line = getattr(node, "lineno", 0)
+        func = node.func
+        d = dotted_name(func)
+        tail = d.split(".")[-1] if d else None
+        # (1) int8 contraction without preferred_element_type
+        if tail in _CONTRACTIONS:
+            ops = [_infer(ctx, env, a, line) for a in node.args]
+            if _INT8 in ops and _pet_dtype(ctx, node) is None:
+                yield ctx.make_finding(
+                    CODE, node,
+                    f"`{tail}` over int8 operands without "
+                    "preferred_element_type=jnp.int32: the contraction "
+                    "accumulates off the MXU int8->int32 path and the "
+                    "histogram loses integer exactness")
+        # (2) premature f32 upcast of quantized state
+        if isinstance(func, ast.Attribute) and func.attr == "astype" \
+                and node.args and not dequant_fn:
+            target = _dtype_of_node(ctx, node.args[0])
+            if target in _F32_NAMES:
+                src = _infer(ctx, env, func.value, line)
+                if src in (_INT8, _INT32Q) \
+                        and not _scale_multiplied(ctx, node):
+                    kind = ("int8-quantized value" if src == _INT8
+                            else "int32 quantized accumulation state")
+                    yield ctx.make_finding(
+                        CODE, node,
+                        f"f32 upcast of {kind} outside the dequantize "
+                        "point: keep the scan integer and dequantize "
+                        "once at the gain/leaf-value math "
+                        "(`.astype(jnp.float32) * scale`)")
+
+
+def _check_f64_leak(project: ProjectContext, mname: str):
+    mod = project.modules[mname]
+    ctx = mod.ctx
+    if not ctx.jnp_aliases:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted_name(node.func)
+        if d is None or chain_root(node.func) not in ctx.jnp_aliases:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for leaf in ast.walk(arg):
+                value = None
+                n = None
+                if isinstance(leaf, ast.Name):
+                    n = leaf.id
+                    value = project.constant_value_node(mname, n)
+                elif isinstance(leaf, ast.Attribute):
+                    base = dotted_name(leaf.value)
+                    m2 = project.resolve_module(mname, base) \
+                        if base is not None else None
+                    if m2 is not None:
+                        n = leaf.attr
+                        value = project.modules[m2].assigns.get(n)
+                if value is not None and _is_f64_value(value):
+                    yield ctx.make_finding(
+                        CODE, leaf,
+                        f"`{n}` is a float64 constant flowing into "
+                        f"`{d}(...)` while x64 is disabled: silently "
+                        "truncated to f32 (and a recompile bomb if "
+                        "x64 is ever enabled); store it as f32 or "
+                        "keep the f64 math on host")
+
+
+def _is_f64_value(value: ast.AST) -> bool:
+    for n in ast.walk(value):
+        if isinstance(n, ast.Constant) and n.value == "float64":
+            return True
+        if isinstance(n, ast.Attribute) and n.attr == "float64":
+            return True
+    return False
+
+
+def check_project(project: ProjectContext):
+    for mname, mod in project.modules.items():
+        ctx = mod.ctx
+        # module-level scope plus every function, each with fresh state
+        yield from _check_function(ctx, "<module>", ctx.tree)
+        for fi in project.functions.values():
+            if fi.module != mname:
+                continue
+            yield from _check_function(ctx, fi.name, fi.node)
+        yield from _check_f64_leak(project, mname)

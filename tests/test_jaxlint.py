@@ -215,13 +215,38 @@ def test_cli_package_with_baseline_exits_zero(capsys):
     assert rc == 0, capsys.readouterr().out
 
 
-def test_cli_write_baseline_refuses_select(tmp_path, capsys):
-    # a rule-filtered write would silently erase the other rules'
-    # accepted entries
+def test_cli_select_write_baseline_preserves_other_rules(tmp_path,
+                                                         capsys):
+    # regression: a rule-filtered `--write-baseline` used to hold only
+    # the selected findings, silently erasing every other rule's
+    # accepted entries; now it merges
     bl = tmp_path / "bl.json"
+    assert jaxlint_main([str(CORPUS), "--baseline", str(bl),
+                         "--write-baseline", "--root", str(REPO)]) == 0
+    before = jl_baseline.load(str(bl))
+    assert jaxlint_main([str(CORPUS), "--baseline", str(bl), "--select",
+                         "JL005", "--write-baseline",
+                         "--root", str(REPO)]) == 0
+    after = jl_baseline.load(str(bl))
+    assert after == before, \
+        "unselected rules' entries must survive a --select write"
+    # and the merged baseline still gates a full run clean
+    assert jaxlint_main([str(CORPUS), "--baseline", str(bl),
+                         "--root", str(REPO)]) == 0
+
+
+def test_cli_select_filters_baseline_entries(tmp_path, capsys):
+    # regression: a --select run used to judge itself against the FULL
+    # baseline, reporting every other rule's entries as stale
+    bl = tmp_path / "bl.json"
+    assert jaxlint_main([str(CORPUS), "--baseline", str(bl),
+                         "--write-baseline", "--root", str(REPO)]) == 0
+    capsys.readouterr()
     rc = jaxlint_main([str(CORPUS), "--baseline", str(bl), "--select",
-                       "JL001", "--write-baseline", "--root", str(REPO)])
-    assert rc == 2 and not bl.exists()
+                       "JL005", "--root", str(REPO)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "stale" not in out, out
 
 
 def test_cli_write_baseline_round_trip(tmp_path, capsys):
@@ -252,3 +277,248 @@ def test_cli_injected_defect_fails_package_scan(tmp_path):
                          text=True)
     assert bad.returncode == 1, bad.stdout + bad.stderr
     assert "_injected_bad.py" in bad.stdout
+
+
+# ---------------------------------------------------------------------------
+# JL1xx project rules: injected defects in REAL package code.  One
+# package copy per test module; each test applies a mutation, runs the
+# analyzer CLI in a subprocess and asserts the exact rule fires, then
+# restores the file.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def pkg_copy(tmp_path_factory):
+    root = tmp_path_factory.mktemp("jl1xx")
+    shutil.copytree(PKG, root / "lightgbm_tpu",
+                    ignore=shutil.ignore_patterns("__pycache__"))
+    shutil.copy(BASELINE, root / "jaxlint_baseline.json")
+    return root
+
+
+def _lint(root, *extra):
+    cmd = [sys.executable, "-m", "lightgbm_tpu.tools.jaxlint",
+           "lightgbm_tpu", *extra]
+    return subprocess.run(cmd, cwd=root, capture_output=True, text=True)
+
+
+def _mutate(root, rel, old, new):
+    p = root / rel
+    src = p.read_text()
+    assert old in src, f"{rel} no longer contains the injection anchor"
+    p.write_text(src.replace(old, new, 1))
+    return p, src
+
+
+def test_injected_jl101_dropped_signature_field(pkg_copy):
+    """Dropping INT32_SCAN_ROWS from programs_signature — the exact
+    PR-9 review bug — must fire JL101 at the constant's compare site."""
+    p, orig = _mutate(pkg_copy, "lightgbm_tpu/ops/grow.py",
+                      "_CHUNK, COUNT_SPLIT_ROWS, INT32_SCAN_ROWS,",
+                      "_CHUNK, COUNT_SPLIT_ROWS,")
+    try:
+        r = _lint(pkg_copy, "--select", "JL101", "--no-baseline")
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "JL101" in r.stdout and "INT32_SCAN_ROWS" in r.stdout
+    finally:
+        p.write_text(orig)
+
+
+def test_injected_jl101_traced_param_in_key(pkg_copy):
+    """Un-excluding learning_rate (the PR-4 review bug: lr decay forced
+    a program-cache miss per iteration) must fire JL101."""
+    p, orig = _mutate(
+        pkg_copy, "lightgbm_tpu/ops/grow.py",
+        '_NON_TRACE_PARAMS = ("wave_plan", "grower_cache", '
+        '"learning_rate")',
+        '_NON_TRACE_PARAMS = ("wave_plan", "grower_cache")')
+    try:
+        r = _lint(pkg_copy, "--select", "JL101", "--no-baseline")
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "JL101" in r.stdout and "learning_rate" in r.stdout
+    finally:
+        p.write_text(orig)
+
+
+def test_injected_jl111_f32_upcast_in_quant_path(pkg_copy):
+    """An f32 upcast on the int8 stat mask upstream of the dequantize
+    point (the shape of PR-9's 'f32 dequantize left upstream of the
+    find-best scan' bug) must fire JL111."""
+    anchor = "            m8 = one_f.astype(jnp.int8)\n"
+    p, orig = _mutate(pkg_copy, "lightgbm_tpu/ops/grow.py", anchor,
+                      anchor + "            m8 = m8.astype(jnp.float32)\n")
+    try:
+        r = _lint(pkg_copy, "--select", "JL111", "--no-baseline")
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "JL111" in r.stdout and "f32 upcast" in r.stdout
+    finally:
+        p.write_text(orig)
+
+
+def test_injected_jl121_lock_order_inversion(pkg_copy):
+    """Opposite acquisition orders of the program-cache and plan-cache
+    locks across ops/grow.py and ops/stage_plan.py must fire JL121 on
+    both edges."""
+    grow = pkg_copy / "lightgbm_tpu/ops/grow.py"
+    plan = pkg_copy / "lightgbm_tpu/ops/stage_plan.py"
+    g_orig, p_orig = grow.read_text(), plan.read_text()
+    grow.write_text(g_orig + (
+        "\n\ndef _diag_flush_plans(base):\n"
+        "    with _PROGRAM_CACHE_LOCK:\n"
+        "        return stage_plan_mod.cached_plan(base)\n"))
+    plan.write_text(p_orig + (
+        "\n\ndef _diag_rebuild(config):\n"
+        "    from . import grow\n"
+        "    with _PLAN_CACHE_LOCK:\n"
+        "        return grow.get_grower_programs(1024, 1, 64, 4,\n"
+        "                                        False, config)\n"))
+    try:
+        r = _lint(pkg_copy, "--select", "JL121", "--no-baseline")
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert r.stdout.count("JL121") >= 2
+        assert "lock-order inversion" in r.stdout
+    finally:
+        grow.write_text(g_orig)
+        plan.write_text(p_orig)
+
+
+def test_injected_jl131_wall_clock_in_checkpoint(pkg_copy):
+    """A wall-clock stamp in the pipeline checkpoint meta payload must
+    fire JL131 at the sink call."""
+    anchor = 'meta={"policy": policy, "rows": int(rows),'
+    p, orig = _mutate(pkg_copy, "lightgbm_tpu/pipeline/core.py", anchor,
+                      'meta={"policy": policy, "at": time.time(),'
+                      ' "rows": int(rows),')
+    try:
+        r = _lint(pkg_copy, "--select", "JL131", "--no-baseline")
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "JL131" in r.stdout and "wall-clock" in r.stdout
+    finally:
+        p.write_text(orig)
+
+
+def test_baseline_has_no_project_rule_entries():
+    """New rules start at zero debt: the committed baseline may not
+    contain a single JL1xx entry."""
+    accepted = jl_baseline.load(str(BASELINE))
+    bad = [k for k in accepted if k[1].startswith("JL1")]
+    assert not bad, f"JL1xx baseline entries are not allowed: {bad}"
+    assert sum(accepted.values()) <= 20, \
+        "baseline ratchet: keep the accepted-debt total at or below 20"
+
+
+# ---------------------------------------------------------------------------
+# incremental cache
+# ---------------------------------------------------------------------------
+
+def test_cache_warm_run_replays_identical_findings(tmp_path):
+    corpus_copy = tmp_path / "corpus"
+    shutil.copytree(CORPUS, corpus_copy)
+    cache = tmp_path / ".jaxlint_cache"
+    cold = jaxlint.analyze_paths([str(corpus_copy)], root=str(tmp_path),
+                                 cache_dir=str(cache))
+    assert not cold.from_cache and (cache / "cache.json").exists()
+    warm = jaxlint.analyze_paths([str(corpus_copy)], root=str(tmp_path),
+                                 cache_dir=str(cache))
+    assert warm.from_cache
+    key = lambda fs: sorted((f.path, f.rule, f.line, f.message)
+                            for f in fs)
+    assert key(warm.findings) == key(cold.findings)
+    assert key(warm.suppressed) == key(cold.suppressed)
+
+
+def test_cache_invalidated_by_content_change(tmp_path):
+    corpus_copy = tmp_path / "corpus"
+    shutil.copytree(CORPUS, corpus_copy)
+    cache = tmp_path / ".jaxlint_cache"
+    jaxlint.analyze_paths([str(corpus_copy)], root=str(tmp_path),
+                          cache_dir=str(cache))
+    target = corpus_copy / "set_order.py"
+    target.write_text(target.read_text()
+                      + "\n\ndef extra(x):\n    for v in set(x):\n"
+                      "        print(v)\n")
+    res = jaxlint.analyze_paths([str(corpus_copy)], root=str(tmp_path),
+                                cache_dir=str(cache))
+    assert not res.from_cache
+    assert any(f.path.endswith("set_order.py")
+               and f.line > len(target.read_text().splitlines()) - 4
+               for f in res.findings if f.rule == "JL005")
+    # warm again after the change is cached
+    res2 = jaxlint.analyze_paths([str(corpus_copy)], root=str(tmp_path),
+                                 cache_dir=str(cache))
+    assert res2.from_cache
+
+
+def test_cache_select_run_filters_but_never_writes(tmp_path):
+    corpus_copy = tmp_path / "corpus"
+    shutil.copytree(CORPUS, corpus_copy)
+    cache = tmp_path / ".jaxlint_cache"
+    jaxlint.analyze_paths([str(corpus_copy)], root=str(tmp_path),
+                          cache_dir=str(cache))
+    stamp = (cache / "cache.json").read_bytes()
+    res = jaxlint.analyze_paths([str(corpus_copy)], root=str(tmp_path),
+                                select={"JL005"}, cache_dir=str(cache))
+    assert {f.rule for f in res.findings} == {"JL005"}
+    assert (cache / "cache.json").read_bytes() == stamp
+
+
+# ---------------------------------------------------------------------------
+# --explain
+# ---------------------------------------------------------------------------
+
+def test_cli_explain_prints_rule_doc(capsys):
+    assert jaxlint_main(["--explain", "JL101"]) == 0
+    out = capsys.readouterr().out
+    assert "JL101" in out and "programs_signature" in out
+
+
+def test_cli_explain_unknown_rule(capsys):
+    assert jaxlint_main(["--explain", "JL999"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# review regressions: rule false negatives caught and fixed in PR 10
+# ---------------------------------------------------------------------------
+
+def _project_findings(rule_mod, src, name="m.py"):
+    from lightgbm_tpu.tools.jaxlint.context import FileContext
+    from lightgbm_tpu.tools.jaxlint.project import ProjectContext
+    return list(rule_mod.check_project(
+        ProjectContext([FileContext(src, name)])))
+
+
+def test_jl121_multi_item_with_orders_left_to_right():
+    # `with A, B:` acquires A then B — an inversion written that way
+    # must be flagged just like nested `with` blocks
+    from lightgbm_tpu.tools.jaxlint.rules import lock_order
+    src = (
+        "import threading\n"
+        "_A_LOCK = threading.Lock()\n"
+        "_B_LOCK = threading.Lock()\n"
+        "def f():\n"
+        "    with _A_LOCK, _B_LOCK:\n"
+        "        pass\n"
+        "def g():\n"
+        "    with _B_LOCK:\n"
+        "        with _A_LOCK:\n"
+        "            pass\n")
+    findings = _project_findings(lock_order, src)
+    assert len(findings) >= 2
+    assert all("lock-order inversion" in f.message for f in findings)
+
+
+def test_jl131_param_taint_survives_local_alias():
+    # a callee that copies its tainted parameter into a local before
+    # the sink call must still attribute the hit to the caller
+    from lightgbm_tpu.tools.jaxlint.rules import determinism
+    src = (
+        "import time\n"
+        "def save_pipeline_checkpoint(d, meta):\n"
+        "    pass\n"
+        "def _save(d, meta):\n"
+        "    m = meta\n"
+        "    save_pipeline_checkpoint(d, m)\n"
+        "def caller(d):\n"
+        "    meta = {\"at\": time.time()}\n"
+        "    _save(d, meta)\n")
+    findings = _project_findings(determinism, src)
+    assert any("wall-clock" in f.message for f in findings)
