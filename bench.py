@@ -680,6 +680,158 @@ def run_quant(args) -> dict:
     }
 
 
+def run_shard(args) -> dict:
+    """Single-controller sharded-training benchmark (docs/Sharding.md):
+    single-device vs N-device legs over ONE shared BinnedDataset in ONE
+    process, plus a side-by-side against the multiprocess-style
+    tree_learner=data mesh path — MULTICHIP_r06 as a single command.
+
+    Emits ``shard_scaling_efficiency`` (= t_single / (D * t_sharded),
+    strong scaling at fixed global rows), ``psum_ms_per_tree`` (the
+    collective probe x waves/tree: the growth loop's entire sync cost),
+    and — since the suite defaults to ``grad_quant_bits=8``'s int32
+    scan — ``trees_byte_identical`` between the legs (the
+    docs/Sharding.md contract, also gated in CI by check_shard.py).
+
+    With fewer than 2 visible devices on a CPU backend the suite
+    re-execs itself once under a forced 4-device host mesh, so the one
+    command works on the container AND the TPU driver."""
+    import jax
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data.dataset import BinnedDataset
+
+    want_d = int(getattr(args, "shard_devices", 0) or 0)
+    if len(jax.devices()) < 2:
+        if os.environ.get("BENCH_SHARD_REEXEC"):
+            raise RuntimeError(
+                "--suite shard needs >= 2 devices and the forced host "
+                "mesh did not materialize")
+        import subprocess
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count="
+                            + str(want_d or 4)).strip()
+        env["BENCH_SHARD_REEXEC"] = "1"
+        proc = subprocess.run([sys.executable] + sys.argv, env=env,
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"shard re-exec child failed rc={proc.returncode}:\n"
+                f"{proc.stderr[-2000:]}")
+        for ln in reversed(proc.stdout.splitlines()):
+            try:
+                child = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            child["reexec_forced_devices"] = want_d or 4
+            # keep the child's telemetry digest (which saw the sharded
+            # run) out of main()'s way — it overwrites "obs" with this
+            # parent process's registry
+            if "obs" in child:
+                child["obs_child"] = child.pop("obs")
+            return child
+        raise RuntimeError("shard re-exec child printed no JSON")
+
+    d = want_d or len(jax.devices())
+    # int8 by default: the sharded byte-identity contract lives on the
+    # int32 scan, and it is the production regime the suite certifies
+    quant = args.quant_bits if args.quant_bits else 8
+    base = {
+        "objective": "binary", "metric": "auc",
+        "num_leaves": args.num_leaves, "max_bin": args.max_bin,
+        "learning_rate": args.learning_rate,
+        "min_data_in_leaf": 20, "min_sum_hessian_in_leaf": 1e-3,
+        "verbosity": 0, "wave_plan": "fixed", "device_growth": "on",
+        "grad_quant_bits": quant,
+    }
+    t0 = time.perf_counter()
+    if args.host_data:
+        x, y = synth_higgs(args.rows)
+        ds = BinnedDataset.construct_from_matrix(x, Config(base))
+    else:
+        x, y = synth_higgs_device(args.rows)
+        ds = BinnedDataset.construct_from_device_matrix(x, Config(base))
+        jax.block_until_ready(ds.binned)
+    ds.metadata.set_label(y)
+    t_prep = time.perf_counter() - t0
+
+    legs = [
+        ("single", {"data_sharding": "off"}),
+        ("sharded", {"data_sharding": "single_controller",
+                     "shard_devices": d}),
+        # the multiprocess-mesh analog: the faithful per-split worker
+        # learner over the same device mesh (no fused scan, per-wave
+        # host dispatch) — the path single-controller sharding replaces
+        ("mp_mesh", {"data_sharding": "off", "device_growth": "off",
+                     "tree_learner": "data", "num_machines": d,
+                     "grad_quant_bits": 0}),
+    ]
+    leg_out = {}
+    models = {}
+    psum = None
+    for name, extra in legs:
+        cfg = Config({**base, **extra})
+        bst = create_boosting(cfg)
+        t0 = time.perf_counter()
+        bst.init_train(ds)
+        t_init = time.perf_counter() - t0
+        chunk, warm, t_warm, timed_s, iters_timed = timed_train(
+            bst, args.iters, args.chunk)
+        per_iter = timed_s / max(iters_timed, 1)
+        grower = getattr(bst, "_grower", None)
+        leg_out[name] = {
+            "ms_per_tree": round(1000.0 * per_iter, 2),
+            "timed_s": round(timed_s, 3),
+            "timed_iters": iters_timed,
+            "warmup_compile_s": round(t_warm + t_init, 2),
+            "waves_per_tree": _waves_per_tree(bst),
+            "fused": bool(chunk),
+            "int_scan": bool(getattr(grower, "int_scan", False)),
+        }
+        if name in ("single", "sharded"):
+            bst._flush_pending()
+            models[name] = bst.model_to_string().split("\nparameters:",
+                                                       1)[0]
+        if name == "sharded" and grower is not None:
+            psum = grower.profile_psum(reps=5)
+        del bst
+
+    single_ms = leg_out["single"]["ms_per_tree"]
+    shard_ms = leg_out["sharded"]["ms_per_tree"]
+    waves = leg_out["sharded"]["waves_per_tree"] or 0.0
+    psum_ms = (psum or {}).get("psum_ms")
+    return {
+        "metric": f"shard_suite_higgs_{args.rows}x28_{args.iters}iter"
+                  f"_{d}dev_ms_per_tree",
+        "value": shard_ms,
+        "unit": "ms",
+        "rows": args.rows,
+        "iters": args.iters,
+        "num_leaves": args.num_leaves,
+        "max_bin": args.max_bin,
+        "grad_quant_bits": quant,
+        "devices": d,
+        "prep_s": round(t_prep, 2),
+        "legs": leg_out,
+        # strong scaling at fixed global rows: 1.0 = perfect, CPU
+        # forced-host meshes share cores so expect << 1 off-chip
+        "shard_scaling_efficiency": round(
+            single_ms / max(d * shard_ms, 1e-9), 4),
+        "speedup_vs_single": round(single_ms / max(shard_ms, 1e-9), 3),
+        "speedup_vs_mp_mesh": round(
+            leg_out["mp_mesh"]["ms_per_tree"] / max(shard_ms, 1e-9), 3),
+        "psum_ms": psum_ms,
+        "psum_ms_per_tree": round(psum_ms * waves, 3)
+        if psum_ms is not None else None,
+        "trees_byte_identical": models["single"] == models["sharded"],
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "host_sentinel_ms": host_sentinel_ms(),
+    }
+
+
 def _coldstart_child(cmd, env, tag, expect_json=True):
     """Run a fresh-process bench/warmup child; returns its last
     parseable JSON line.  ``expect_json=False`` for the warmup CLI
@@ -874,9 +1026,16 @@ def main() -> int:
                     help="device = on-device wave grower (one dispatch per "
                          "iteration); host = host-driven learner; auto = "
                          "device on TPU")
+    ap.add_argument("--shard-devices", type=int,
+                    default=int(os.environ.get("BENCH_SHARD_DEVICES",
+                                               "0")),
+                    help="--suite shard: mesh size for the sharded leg "
+                         "(0 = all visible devices; on a 1-device CPU "
+                         "backend the suite re-execs itself under a "
+                         "forced 4-device host mesh)")
     ap.add_argument("--suite",
                     choices=["all", "higgs", "mslr", "cache", "serve",
-                             "coldstart", "quant"],
+                             "coldstart", "quant", "shard"],
                     default=os.environ.get("BENCH_SUITE", "all"),
                     help="all = HIGGS headline + MSLR lambdarank "
                          "(both north stars, BASELINE.md); cache = the "
@@ -890,7 +1049,13 @@ def main() -> int:
                          "quant = paired f32 / int8-einsum / int8-pallas "
                          "legs over one shared dataset in one process, "
                          "emitting ms_per_tree per leg + the speedup "
-                         "matrix + kernel routing counters (BENCH_r06)")
+                         "matrix + kernel routing counters (BENCH_r06); "
+                         "shard = single-device vs N-device single-"
+                         "controller legs + the multiprocess mesh path "
+                         "over one shared dataset, emitting "
+                         "shard_scaling_efficiency, psum_ms_per_tree "
+                         "and the byte-identity verdict (MULTICHIP_r06, "
+                         "docs/Sharding.md)")
     ap.add_argument("--compile-cache-dir",
                     default=os.environ.get(
                         "LGBM_TPU_COMPILE_CACHE",
@@ -955,6 +1120,8 @@ def main() -> int:
         args.suite = "cache"
     if args.suite == "coldstart":
         result = run_coldstart(args)
+    elif args.suite == "shard":
+        result = run_shard(args)
     elif args.suite == "quant":
         result = run_quant(args)
     elif args.suite == "cache":

@@ -291,14 +291,24 @@ class GBDT:
         # on-device wave grower (one dispatch per iteration, no per-split
         # host sync) when the configuration is eligible
         mode = str(getattr(cfg, "device_growth", "off")).lower()
+        from ..ops import shard as shard_mod
+        shard_wanted = shard_mod.sharding_mode(cfg) == "single_controller"
+        # data_sharding is an explicit opt-in, so device_growth=auto
+        # turns the grower on for it even off-TPU (the sharded scan IS
+        # the device grower; the host learner cannot shard this way)
         want = mode == "on" or (mode == "auto"
-                                and jax.default_backend() == "tpu")
+                                and (jax.default_backend() == "tpu"
+                                     or shard_wanted))
         if want:
             serial = (cfg.tree_learner == "serial"
                       or int(cfg.num_machines) <= 1)
+            mesh = shard_mod.resolve_shard_mesh(cfg) \
+                if (serial and shard_wanted) else None
+            n_shards = int(mesh.devices.size) if mesh is not None else 1
             if serial and device_growth_eligible(cfg, train_set,
                                                  self.objective,
-                                                 self.num_model):
+                                                 self.num_model,
+                                                 n_shards=n_shards):
                 # row bucketing needs row-local fused gradients (a
                 # bucket-padded row must not perturb real rows):
                 # lambdarank's query-segment formula opts out
@@ -307,7 +317,8 @@ class GBDT:
                              and getattr(self.objective,
                                          "device_grad_rowwise", True))
                 self._grower = DeviceGrower(train_set, cfg,
-                                            row_bucketing=bucket_ok)
+                                            row_bucketing=bucket_ok,
+                                            mesh=mesh)
                 log_info("Using on-device tree growth (device_growth="
                          f"{mode})")
                 wp = str(getattr(cfg, "wave_plan", "auto")).lower()
@@ -634,6 +645,14 @@ class GBDT:
                 or self.train_set.num_features == 0
                 or self.objective is None
                 or not self.class_need_train[0]):
+            return None
+        if (getattr(self._grower, "mesh", None) is not None
+                and not getattr(self.objective, "device_grad_rowwise",
+                                True)):
+            # sharded fused gradients run per shard on LOCAL rows, so
+            # the formula must be row-local (lambdarank's query-segment
+            # sums are not); the per-iteration sharded path still works
+            # (gradients come in globally computed)
             return None
         if self._fused_grad is False:
             self._fused_grad = self.objective.device_grad()

@@ -318,6 +318,20 @@ def summary() -> Dict:
             "swaps": snap["counters"].get("serve.swaps", 0),
             "rows": snap["counters"].get("serve.rows", 0),
         }
+    shard_devices = snap["gauges"].get("shard.devices")
+    if shard_devices:
+        # single-controller sharded training ran: attribute collective
+        # time the way grow.hist.* attributes kernel routing — BENCH_r06
+        # reads this digest to separate psum cost from histogram compute
+        psum = snap["timings"].get("shard.psum")
+        out["shard"] = {
+            "devices": int(shard_devices),
+            "local_rows": snap["gauges"].get("shard.local_rows"),
+            "sharded_dispatches": snap["counters"].get(
+                "grow.sharded_dispatches", 0),
+            "psum_ms": round(psum["p50_s"] * 1e3, 3) if psum else None,
+            "psum_probes": psum["count"] if psum else 0,
+        }
     injected = sum(v for k, v in snap["counters"].items()
                    if k.startswith("fault."))
     retries = snap["counters"].get("retry.attempts", 0)

@@ -61,6 +61,17 @@ def bagging_row_mask(seed, n_pad: int, num_data: int, fraction):
     return sel.astype(jnp.float32)[:num_data]
 
 
+def bagging_row_mask_global(seed, n_pad: int, num_data, fraction):
+    """The FULL ``(n_pad,)`` f32 mask of the same draw
+    :func:`bagging_row_mask` slices — the sharded fused scan takes each
+    shard's block of this global-row-indexed mask, which is what makes
+    bags shard-invariant (the same rows are in-bag whatever the mesh
+    size, bit-for-bit)."""
+    _, sel = _bag_selection(jax.random.PRNGKey(seed), n_pad, num_data,
+                            fraction)
+    return sel.astype(jnp.float32)
+
+
 @functools.partial(jax.jit, static_argnames=("n_pad",))
 def goss_partition(key, grad_abs, n_pad, num_data, top_rate, other_rate):
     """GOSS selection on |g*h| scores summed over classes.

@@ -75,7 +75,10 @@ import numpy as np
 
 from .. import obs
 from . import stage_plan as stage_plan_mod
-from .histogram import bucket_size, quantize_gh
+from .histogram import (QUANT_MAX, bucket_size, quant_scales, quantize_gh,
+                        stochastic_round_with)
+from .shard import (ShardSpec, local_valid_rows, shard_map_compat,
+                    slice_global_draw)
 from .split import (F_DEFAULT_LEFT, F_FEATURE, F_GAIN, F_IS_CAT, F_LEFT_C,
                     F_LEFT_G, F_LEFT_H, F_LEFT_OUT, F_RIGHT_C, F_RIGHT_G,
                     F_RIGHT_H, F_RIGHT_OUT, F_THRESHOLD, FeatureMeta,
@@ -232,9 +235,16 @@ class GrowerPrograms:
 
     def __init__(self, *, num_data: int, num_groups: int, nb: int,
                  num_features: int, has_cat: bool, config,
-                 plan: list, plan_source: str = "default"):
+                 plan: list, plan_source: str = "default",
+                 shard: Optional[ShardSpec] = None, mesh=None):
         self.config = config.clone()
         config = self.config
+        # sharded layout (ops/shard.py): ``num_data`` is then the
+        # PER-SHARD padded row count, ``shard`` carries the global facts
+        # (real rows, canonical draw shapes) and ``mesh`` the topology.
+        # mesh is metadata, not device data — programs stay data-free.
+        self.shard = shard
+        self.mesh = mesh
         self.num_data = int(num_data)
         self.num_groups = int(num_groups)
         self.nb = int(nb)
@@ -262,8 +272,13 @@ class GrowerPrograms:
         # the parent-minus-sibling subtraction become exact.  The bound
         # is on n_pad: the stage-profiling probes weight every padded
         # row, and pad rows are zero-masked in production anyway.
+        # Sharded, the bound applies to the GLOBAL padded row space —
+        # the psum accumulates |sum q| <= 127 * total rows across the
+        # whole mesh into the same int32 cells.
+        int_rows = self.n_pad if shard is None \
+            else shard.n_shards * self.n_pad
         self.int_scan = bool(self.quant_bits) \
-            and self.n_pad <= INT32_SCAN_ROWS
+            and int_rows <= INT32_SCAN_ROWS
         # Wave cost measured on the chip (scripts/ubench_hist.py,
         # 10.5M rows): ~15.9 ms fixed (the one-hot operand generation
         # over all N, width-independent) + ~0.203 ms per stat column —
@@ -303,13 +318,25 @@ class GrowerPrograms:
         # recompile tracking: these TrackedJit wrappers are shared by
         # every grower that adopts this programs object, so in the
         # retrain-every-window pattern a warm window re-dispatches into
-        # already-compiled programs and obs records ZERO new compiles
-        self._grow = obs.track_jit(
-            "grow", jax.jit(functools.partial(self._grow_impl,
-                                              with_mask=False)))
-        self._grow_masked = obs.track_jit(
-            "grow_masked", jax.jit(functools.partial(self._grow_impl,
-                                                     with_mask=True)))
+        # already-compiled programs and obs records ZERO new compiles.
+        # Sharded, the same _grow_impl runs per shard under shard_map
+        # (jit outside, shard_map inside) with the psum/pmax hooks
+        # active — one jitted program family either way.
+        if shard is None:
+            self._grow = obs.track_jit(
+                "grow", jax.jit(functools.partial(self._grow_impl,
+                                                  with_mask=False)))
+            self._grow_masked = obs.track_jit(
+                "grow_masked",
+                jax.jit(functools.partial(self._grow_impl,
+                                          with_mask=True)))
+        else:
+            self._grow = obs.track_jit(
+                "grow_sharded",
+                jax.jit(self._shard_wrap(with_mask=False)))
+            self._grow_masked = obs.track_jit(
+                "grow_sharded_masked",
+                jax.jit(self._shard_wrap(with_mask=True)))
         self._fused = {}   # scan length -> jitted multi-iteration program
         # one programs object is served process-wide from _PROGRAM_CACHE,
         # so lazy per-length entries need their own lock
@@ -328,7 +355,11 @@ class GrowerPrograms:
         self._bag_fraction = float(config.bagging_fraction)
         self._bag_freq = int(config.bagging_freq)
         self._bag_seed = int(config.bagging_seed) & 0x7FFFFFFF
-        self._bag_npad = bucket_size(max(self.num_data, 1))
+        # sharded: the bagging uniform draw keeps the CANONICAL GLOBAL
+        # shape (the draw shape is part of the stream), each shard
+        # slices its block — bags are shard-invariant bit-for-bit
+        self._bag_npad = shard.bag_npad if shard is not None \
+            else bucket_size(max(self.num_data, 1))
         self._quant_seed = (int(config.seed) + 5) & 0x7FFFFFFF
 
     # ------------------------------------------------------------------
@@ -340,6 +371,75 @@ class GrowerPrograms:
             return jnp.ones(self._ff_nf, dtype=bool)
         return feature_fraction_mask(self._ff_seed, tree_idx,
                                      self._ff_nf, self._ff_k)
+
+    # ------------------------------------------------------------------
+    # single-controller sharding hooks (ops/shard.py).  All no-ops when
+    # self.shard is None, so the unsharded programs trace identically
+    # to the pre-sharding code.
+    # ------------------------------------------------------------------
+    def _shard_wrap(self, *, with_mask: bool):
+        """shard_map-wrapped per-iteration program: row buffers split
+        over the mesh axis, scalars/metadata replicated, the traced
+        GLOBAL ``num_valid`` converted to the shard-local cutoff.  The
+        tree outputs are replicated by construction (they derive from
+        the psum-reduced histograms), so out_specs take each shard's
+        identical copy."""
+        from jax.sharding import PartitionSpec as P
+        sp = self.shard
+        row = P(sp.axis)
+        rep = P()
+        in_specs = (P(sp.axis, None), P(None, sp.axis), row, row, row,
+                    rep, rep, row, rep, rep, rep, rep, rep)
+        out_specs = (row,) + (rep,) * 7
+
+        def body(binned, binned_t, score, grad, hess, feature_mask, lr,
+                 row_mask, tree_idx, num_valid, meta, hyper, tables):
+            nv_loc = local_valid_rows(sp, self.n_pad, num_valid)
+            return self._grow_impl(binned, binned_t, score, grad, hess,
+                                   feature_mask, lr, row_mask, tree_idx,
+                                   nv_loc, meta, hyper, tables,
+                                   with_mask=with_mask)
+
+        return shard_map_compat(body, self.mesh, in_specs, out_specs)
+
+    def _psum_hist(self, hist):
+        """The growth loop's ONE cross-device sync point: sum the wave
+        histograms over the mesh axis.  int32 histograms (the quantized
+        int-scan regime) psum exactly; f32 regimes psum g/h in f32 (the
+        reduction order is the compiled program's — deterministic
+        run-to-run) and counts as int32, keeping row counts exact past
+        2^24 global rows (per-shard counts are integer-exact by the
+        striping layout, so the cast is exact)."""
+        sp = self.shard
+        if sp is None:
+            return hist
+        if hist.dtype == jnp.int32:
+            return jax.lax.psum(hist, sp.axis)
+        gh = jax.lax.psum(hist[..., :2], sp.axis)
+        cnt = jax.lax.psum(jnp.round(hist[..., 2]).astype(jnp.int32),
+                           sp.axis).astype(jnp.float32)
+        return jnp.concatenate([gh, cnt[..., None]], axis=-1)
+
+    def _quantize_sharded(self, grad, hess, qkey):
+        """Sharded :func:`~.histogram.quantize_gh`: the per-tree global
+        scale is the pmax of shard-local maxes (max is associative-exact,
+        so it equals the single-device scale bitwise), and the rounding
+        noise is drawn at the canonical global shape ``draw_npad`` —
+        the single-device grower's chunk pad — then sliced to this
+        shard's rows, so every real row sees the exact noise value the
+        unsharded path would give it."""
+        sp = self.shard
+        sg, sh = quant_scales(grad, hess)
+        sg = jax.lax.pmax(sg, sp.axis)
+        sh = jax.lax.pmax(sh, sp.axis)
+        kg, kh = jax.random.split(qkey)
+
+        def noise(k):
+            return slice_global_draw(
+                sp, jax.random.uniform(k, (sp.draw_npad,)), self.n_pad)
+
+        return (sg, sh, stochastic_round_with(grad, sg, noise(kg)),
+                stochastic_round_with(hess, sh, noise(kh)))
 
     # ------------------------------------------------------------------
     # wave histogram: one dense pass for up to W pending leaves
@@ -438,7 +538,12 @@ class GrowerPrograms:
                              axis=-1)
         else:
             hist = _combine_hist_cols(acc, k)                    # (G,NB,W,3)
-        return hist.transpose(2, 0, 1, 3).reshape(w, self.num_slots, 3)
+        # sharded: psum the combined per-shard histograms — the growth
+        # loop's sole cross-device sync (docs/Sharding.md); everything
+        # downstream (find-best, totals, root stats) then runs on
+        # replicated global values
+        return self._psum_hist(
+            hist.transpose(2, 0, 1, 3).reshape(w, self.num_slots, 3))
 
     # ------------------------------------------------------------------
     def _stat_columns(self, grad, hess, one_f, tree_idx):
@@ -452,7 +557,10 @@ class GrowerPrograms:
         if self.quant_bits:
             qkey = jax.random.fold_in(
                 jax.random.PRNGKey(self._quant_seed), tree_idx)
-            sg, sh, gq, hq = quantize_gh(grad, hess, qkey)
+            if self.shard is not None:
+                sg, sh, gq, hq = self._quantize_sharded(grad, hess, qkey)
+            else:
+                sg, sh, gq, hq = quantize_gh(grad, hess, qkey)
             m8 = one_f.astype(jnp.int8)
             if k == 6:
                 # striped g/h/count columns: each stripe's int32
@@ -861,19 +969,62 @@ class GrowerPrograms:
         rec_f_out = final.rec_f
 
         if self.quant_bits:
-            # f32 leaf-value REFIT (Shi et al. §4.3): tree STRUCTURE came
-            # from quantized histograms, but each final leaf's value is
-            # recomputed from the full-precision gradients with one
-            # hi/lo-bf16 one-hot contraction (same cost class as the
-            # score update), then written back into the split records so
+            # full-precision leaf-value REFIT (Shi et al. §4.3): tree
+            # STRUCTURE came from quantized histograms, but each final
+            # leaf's value is recomputed from the full-precision
+            # gradients, then written back into the split records so
             # host-materialized trees match the device score update.
-            one_b = one_f.astype(jnp.bfloat16)
-            cols4 = jnp.stack(_hi_lo_cols(grad, hess, one_b), 1)  # (n, 4)
-            ohl = jax.nn.one_hot(leaf_final, L, dtype=jnp.bfloat16)
-            sums = jnp.einsum("nl,nk->lk", ohl, cols4,
-                              preferred_element_type=jnp.float32)
-            refit = self._leaf_output(sums[:, 0] + sums[:, 1],
-                                      sums[:, 2] + sums[:, 3], hyper)
+            if int_scan:
+                # exact integer refit: each masked gradient is split
+                # into THREE base-128 int8 digits against the (global)
+                # quantization scale — deterministic round-to-nearest,
+                # no noise — and the per-leaf digit sums accumulate
+                # int8->int32 on the MXU.  Per-row representation error
+                # is <= scale/2^15 ~ max|g| * 2^-22 (f32-class), the
+                # SUMS are bit-exact in any order — which is what keeps
+                # sharded leaf values byte-identical to single-device
+                # (an f32 contraction's accumulation order would not
+                # survive the psum split).  |digit sums| <= 127 * rows
+                # stays in int32 under the same INT32_SCAN_ROWS gate as
+                # the histograms.
+                def _digits(x, s):
+                    cols = []
+                    r, sd = x, s
+                    for _ in range(3):
+                        d = jnp.clip(jnp.round(r / sd), -QUANT_MAX,
+                                     QUANT_MAX)
+                        r = r - d * sd
+                        cols.append(d.astype(jnp.int8))
+                        sd = sd / 128.0
+                    return cols
+                dcols = jnp.stack(_digits(grad * one_f, qscales[0])
+                                  + _digits(hess * one_f, qscales[1]), 1)
+                oh8 = jax.nn.one_hot(leaf_final, L, dtype=jnp.int8)
+                sums6 = jnp.einsum("nl,nk->lk", oh8, dcols,
+                                   preferred_element_type=jnp.int32)
+                if self.shard is not None:
+                    sums6 = jax.lax.psum(sums6, self.shard.axis)
+                f32 = lambda a: a.astype(jnp.float32)
+                gsum = (f32(sums6[:, 0]) + f32(sums6[:, 1]) * (1 / 128.0)
+                        + f32(sums6[:, 2]) * (1 / 16384.0)) * qscales[0]
+                hsum = (f32(sums6[:, 3]) + f32(sums6[:, 4]) * (1 / 128.0)
+                        + f32(sums6[:, 5]) * (1 / 16384.0)) * qscales[1]
+                refit = self._leaf_output(gsum, hsum, hyper)
+            else:
+                # f32 fallback regime: hi/lo-bf16 one-hot contraction
+                # (same cost class as the score update); sharded, the
+                # per-shard partial sums psum in f32 — deterministic,
+                # though not bitwise equal to single-device order (no
+                # byte-identity contract past the int32 bound)
+                one_b = one_f.astype(jnp.bfloat16)
+                cols4 = jnp.stack(_hi_lo_cols(grad, hess, one_b), 1)
+                ohl = jax.nn.one_hot(leaf_final, L, dtype=jnp.bfloat16)
+                sums = jnp.einsum("nl,nk->lk", ohl, cols4,
+                                  preferred_element_type=jnp.float32)
+                if self.shard is not None:
+                    sums = jax.lax.psum(sums, self.shard.axis)
+                refit = self._leaf_output(sums[:, 0] + sums[:, 1],
+                                          sums[:, 2] + sums[:, 3], hyper)
             exists = jnp.arange(L, dtype=jnp.int32) < final.nl
             # each final leaf's value lives in its CREATING record (the
             # last record mentioning the leaf id: left children keep the
@@ -972,17 +1123,28 @@ class GrowerPrograms:
             use_bag = self._bag_fraction < 1.0 and self._bag_freq > 0
             bag_freq, bag_seed = self._bag_freq, self._bag_seed
             bag_frac, bag_npad = self._bag_fraction, self._bag_npad
+            sp = self.shard
 
-            def run(binned, binned_t, score, lr, gargs, it0, num_valid,
-                    meta, hyper, tables, grad_fn):
+            def draw_bag(it):
+                seed = (bag_seed + it) & 0x7FFFFFFF
+                if sp is None:
+                    from .bagging import bagging_row_mask
+                    return bagging_row_mask(seed, bag_npad,
+                                            self.num_data, bag_frac)
+                # sharded: draw the CANONICAL GLOBAL mask (same shape,
+                # same stream as the single-device path) and take this
+                # shard's block — bags are shard-invariant bit-for-bit
+                from .bagging import bagging_row_mask_global
+                full = bagging_row_mask_global(seed, bag_npad,
+                                               sp.global_rows, bag_frac)
+                return slice_global_draw(sp, full, self.n_pad)
+
+            def scan_core(binned, binned_t, score, lr, gargs, it0,
+                          num_valid, meta, hyper, tables, grad_fn):
+                """The K-iteration scan; ``num_valid`` is already the
+                shard-local cutoff when sharded."""
                 no_mask = jnp.zeros((0,), jnp.float32)
                 its = jnp.arange(length, dtype=jnp.int32) + it0
-
-                def draw_bag(it):
-                    from .bagging import bagging_row_mask
-                    return bagging_row_mask(
-                        (bag_seed + it) & 0x7FFFFFFF, bag_npad,
-                        self.num_data, bag_frac)
 
                 def body(carry, it):
                     sc, bmask = (carry if use_bag else (carry, None))
@@ -1013,8 +1175,40 @@ class GrowerPrograms:
                     return final_score, recs
                 return jax.lax.scan(body, score, its)
 
+            if sp is None:
+                run = scan_core
+            else:
+                def run(binned, binned_t, score, lr, gargs, it0,
+                        num_valid, meta, hyper, tables, grad_fn):
+                    # whole-scan shard_map: K trees per dispatch on every
+                    # chip, one histogram psum per wave inside.  Specs
+                    # are built at trace time (gargs structure is part
+                    # of the jit key anyway): per-row gargs leaves ride
+                    # the mesh axis, everything else is replicated.
+                    from jax.sharding import PartitionSpec as P
+                    row, rep = P(sp.axis), P()
+                    total = sp.n_shards * self.n_pad
+                    gspec = jax.tree_util.tree_map(
+                        lambda a: P(sp.axis, *([None] * (a.ndim - 1)))
+                        if (getattr(a, "ndim", 0) >= 1
+                            and a.shape[0] == total) else rep, gargs)
+                    in_specs = (P(sp.axis, None), P(None, sp.axis), row,
+                                rep, gspec, rep, rep, rep, rep, rep)
+                    out_specs = (row, rep)
+
+                    def body(b, bt, sc, lr_, ga, i0, nv, me, hy, ta):
+                        nv_loc = local_valid_rows(sp, self.n_pad, nv)
+                        return scan_core(b, bt, sc, lr_, ga, i0, nv_loc,
+                                         me, hy, ta, grad_fn)
+
+                    return shard_map_compat(
+                        body, self.mesh, in_specs, out_specs)(
+                        binned, binned_t, score, lr, gargs, it0,
+                        num_valid, meta, hyper, tables)
+
             self._fused[length] = obs.track_jit(
-                "fused_train", jax.jit(run, static_argnames=("grad_fn",)),
+                "fused_train_sharded" if sp is not None else "fused_train",
+                jax.jit(run, static_argnames=("grad_fn",)),
                 static_info=(f"len={length}",))
         return self._fused[length]
 
@@ -1047,26 +1241,40 @@ def _config_digest(config) -> str:
 
 
 def programs_signature(num_data: int, num_groups: int, nb: int,
-                       num_features: int, has_cat: bool, config) -> tuple:
+                       num_features: int, has_cat: bool, config,
+                       shard: Optional[ShardSpec] = None) -> tuple:
     """Everything a GrowerPrograms trace depends on besides the stage
     plan: array shapes, bin-structure flags, module tunables and the
     full config (hashed — over-keying only costs cache hits, never
-    correctness)."""
-    return (num_data, num_groups, nb, num_features, bool(has_cat),
+    correctness).  Sharded programs append the mesh size plus the
+    canonical global draw shapes (``num_data`` is then the per-shard
+    row bucket); unsharded signatures keep the historical layout so
+    persisted stage plans stay valid."""
+    base = (num_data, num_groups, nb, num_features, bool(has_cat),
             _CHUNK, COUNT_SPLIT_ROWS, INT32_SCAN_ROWS,
             _config_digest(config))
+    if shard is not None:
+        base = base + (("shard", shard.n_shards, shard.global_rows,
+                        shard.draw_npad, shard.bag_npad),)
+    return base
 
 
 def get_grower_programs(num_data: int, num_groups: int, nb: int,
                         num_features: int, has_cat: bool, config,
                         plan: Optional[list] = None,
-                        plan_source: str = "default") -> GrowerPrograms:
+                        plan_source: str = "default",
+                        shard: Optional[ShardSpec] = None,
+                        mesh=None) -> GrowerPrograms:
     """Fetch (or build) the shared programs for this signature.  When no
     explicit plan is given, a profile-derived plan cached for the same
     signature (``DeviceGrower.profile_stage_plan``) is picked up under
     ``wave_plan=auto``/``profiled``."""
     base = programs_signature(num_data, num_groups, nb, num_features,
-                              has_cat, config)
+                              has_cat, config, shard=shard)
+    if shard is not None and mesh is not None:
+        # same shard layout over a different device set must not share
+        # compiled programs (the mesh is baked into the shard_map)
+        base = base + (tuple(int(d.id) for d in mesh.devices.flat),)
     if plan is None and str(getattr(config, "wave_plan", "auto")).lower() \
             in ("auto", "profiled"):
         cached = stage_plan_mod.cached_plan(base)
@@ -1088,7 +1296,7 @@ def get_grower_programs(num_data: int, num_groups: int, nb: int,
     build = functools.partial(
         GrowerPrograms, num_data=num_data, num_groups=num_groups, nb=nb,
         num_features=num_features, has_cat=has_cat, config=config,
-        plan=plan, plan_source=plan_source)
+        plan=plan, plan_source=plan_source, shard=shard, mesh=mesh)
     if not bool(getattr(config, "grower_cache", True)):
         return build()
     key = base + (pd,)
@@ -1121,10 +1329,16 @@ class DeviceGrower:
     reached through attribute forwarding, so ``grower.hist_cols`` etc.
     keep working."""
 
-    def __init__(self, dataset, config, row_bucketing=None):
+    def __init__(self, dataset, config, row_bucketing=None, mesh=None):
         self.config = config
         self.dataset = dataset
         self.num_data = int(dataset.num_data)
+        # single-controller data-parallel mesh (ops/shard.py): rows are
+        # split over the mesh axis, wave histograms psum-reduce, every
+        # device grows the identical tree.  A 1-device mesh degrades to
+        # the plain unsharded grower (identical programs, no shard_map).
+        self.mesh = mesh if (mesh is not None
+                             and int(mesh.devices.size) > 1) else None
 
         # per-group slot pitch: smallest power of two covering every group
         nb = 64
@@ -1150,9 +1364,58 @@ class DeviceGrower:
         if row_bucketing is None:
             row_bucketing = bool(getattr(config, "train_row_bucketing",
                                          True))
+        quant_on = bool(int(getattr(config, "grad_quant_bits", 0) or 0))
+        if self.mesh is not None:
+            # sharded layout: the GLOBAL row count pads to
+            # n_devices x (per-shard pow2 bucket), so per-shard shapes
+            # stay on the bucket ladder and one compiled program family
+            # covers a whole traffic range of window sizes per mesh
+            # size.  Quantized runs key their rounding stream on the
+            # canonical global shape instead of the bucket (same
+            # reasoning as the unsharded quant/bucketing exclusion), so
+            # they shard exact per-shard rows.
+            from .shard import SHARD_AXIS
+            d = int(self.mesh.devices.size)
+            srows = -(-self.num_data // d)
+            if row_bucketing and not quant_on:
+                b = bucket_size(max(srows, 1))
+                if b >= 2 * COUNT_SPLIT_ROWS:
+                    from ..utils.log import log_info
+                    log_info(
+                        f"train_row_bucketing: per-shard bucket {b} "
+                        f"would reach the striped-count bound; using "
+                        f"exact per-shard rows ({srows})")
+                else:
+                    srows = b
+            n_loc = _ceil_to(max(srows, _CHUNK), _CHUNK)
+            self._shard_spec = ShardSpec(
+                n_shards=d, axis=SHARD_AXIS, global_rows=self.num_data,
+                draw_npad=_ceil_to(max(self.num_data, _CHUNK), _CHUNK),
+                bag_npad=bucket_size(max(self.num_data, 1)))
+            self.row_bucket = int(n_loc)
+            has_cat = bool(np.asarray(dataset.f_is_categorical).any())
+            self.programs = get_grower_programs(
+                self.row_bucket, int(dataset.num_groups), nb,
+                int(dataset.num_features), has_cat, config,
+                shard=self._shard_spec, mesh=self.mesh)
+            self._base_signature = programs_signature(
+                self.row_bucket, int(dataset.num_groups), nb,
+                int(dataset.num_features), has_cat, config,
+                shard=self._shard_spec)
+            self._num_valid = jnp.asarray(self.num_data, jnp.int32)
+            total_rows = d * self.programs.n_pad
+            self._row_pad = total_rows - self.num_data
+            obs.set_gauge("shard.devices", d)
+            obs.set_gauge("shard.local_rows", int(self.programs.n_pad))
+            self._upload_binned(dataset, total_rows - self.num_data)
+            self.meta = FeatureMeta.from_dataset(dataset, slot_stride=nb)
+            self.hyper = SplitHyper.from_config(config)
+            self.tables = FTables.from_dataset(dataset)
+            self.lr = float(config.learning_rate)
+            return
+        self._shard_spec = None
         bucket = self.num_data
-        if row_bucketing and not int(getattr(config, "grad_quant_bits",
-                                             0) or 0):
+        if row_bucketing and not quant_on:
             bucket = bucket_size(max(self.num_data, 1))
             if bucket >= 2 * COUNT_SPLIT_ROWS:
                 # the pow2 bucket would cross the striped-count
@@ -1182,7 +1445,20 @@ class DeviceGrower:
         self._num_valid = jnp.asarray(self.num_data, jnp.int32)
         self._row_pad = self.row_bucket - self.num_data
 
-        pad = self.programs.n_pad - self.num_data
+        self._upload_binned(dataset, self.programs.n_pad - self.num_data)
+
+        self.meta = FeatureMeta.from_dataset(dataset, slot_stride=nb)
+        self.hyper = SplitHyper.from_config(config)
+        self.tables = FTables.from_dataset(dataset)
+        self.lr = float(config.learning_rate)
+
+    def _upload_binned(self, dataset, pad: int):
+        """Upload the (N, G) binned matrix padded by ``pad`` rows, plus
+        its (G, N) device-side transpose (uploading the transpose
+        separately doubled the host->device transfer and the host
+        ascontiguousarray pass — ~seconds at 10M rows).  Sharded, both
+        layouts are placed row-split over the mesh axis so each device
+        holds ONLY its shard's rows."""
         if getattr(dataset, "device_binned", False):
             # matrix already lives in HBM (construct_from_device_matrix)
             binned_d = dataset.binned
@@ -1194,15 +1470,19 @@ class DeviceGrower:
             if pad:
                 binned = np.pad(binned, ((0, pad), (0, 0)))
             self.binned = jnp.asarray(binned)
-        # the (G, N) copy is a device-side transpose: uploading it
-        # separately doubled the host->device transfer and the host
-        # ascontiguousarray pass (~seconds at 10M rows)
-        self.binned_t = jnp.transpose(self.binned)
-
-        self.meta = FeatureMeta.from_dataset(dataset, slot_stride=nb)
-        self.hyper = SplitHyper.from_config(config)
-        self.tables = FTables.from_dataset(dataset)
-        self.lr = float(config.learning_rate)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            axis = self._shard_spec.axis
+            self.binned = jax.device_put(
+                self.binned, NamedSharding(self.mesh, P(axis, None)))
+            # transpose stays device-side; the explicit placement pins
+            # the (G, N) copy column-split so each device again holds
+            # only its rows
+            self.binned_t = jax.device_put(
+                jnp.transpose(self.binned),
+                NamedSharding(self.mesh, P(None, axis)))
+        else:
+            self.binned_t = jnp.transpose(self.binned)
 
     # programs hold every static/trace-level attribute (hist_cols,
     # wave_width, stage_plan, nb, n_pad, quant_bits, feature_mask_for,
@@ -1243,6 +1523,8 @@ class DeviceGrower:
         # routing attribution: which kernel serves this dispatch's
         # full-width histogram stage (BENCH digests read these)
         obs.inc(f"grow.hist.{self.programs.hist_kernel_tag}")
+        if self.programs.shard is not None:
+            obs.inc("grow.sharded_dispatches")
         ti = jnp.asarray(tree_idx, jnp.int32)
         if self._row_pad:
             # bucket pad: the program's row dim is the pow2 bucket; the
@@ -1291,9 +1573,12 @@ class DeviceGrower:
             return a
 
         kernel_tag = self.programs.hist_kernel_tag
+        sharded = self.programs.shard is not None
 
         def run(binned, binned_t, score, lr, gargs, it0, grad_fn):
             obs.inc(f"grow.hist.{kernel_tag}")
+            if sharded:
+                obs.inc("grow.sharded_dispatches")
             if row_pad:
                 score = jnp.pad(score, (0, row_pad))
                 gargs = jax.tree_util.tree_map(_pad_rows, gargs)
@@ -1331,6 +1616,17 @@ class DeviceGrower:
 
         reps = max(1, int(reps))
         progs = self.programs
+        if progs.shard is not None:
+            # the stage probes dispatch _wave_hist outside shard_map,
+            # where the mesh axis is unbound; sharded growers keep the
+            # byte-stable default ladder (a profiled plan would also
+            # have to match across mesh sizes to preserve the
+            # byte-identity contract, docs/Sharding.md)
+            return {"stage_ms": {}, "fixed_ms": None, "col_ms": None,
+                    "plan": list(progs.stage_plan),
+                    "plan_digest":
+                        stage_plan_mod.plan_digest(progs.stage_plan),
+                    "installed": False}
         if install and progs.plan_source in ("profiled", "persisted"):
             # already measured for this signature in this process, or
             # adopted from the on-disk store: zero re-profiles
@@ -1419,6 +1715,40 @@ class DeviceGrower:
                 "installed": installed}
 
     # ------------------------------------------------------------------
+    def profile_psum(self, reps: int = 10) -> Optional[dict]:
+        """Time ONE wave-histogram-shaped psum on the mesh — the growth
+        loop's sole sync point — via a separately-jitted shard_map whose
+        body is just the collective, so the measured ms is communication
+        (plus dispatch floor), not histogram compute.  Records the
+        ``shard.psum`` timing and ``shard.psum_ms`` gauge that
+        ``obs.summary()``'s shard digest and ``bench.py --suite shard``
+        read; returns ``{"psum_ms": ...}``, or None unsharded."""
+        import time as _time
+
+        progs = self.programs
+        sp = progs.shard
+        if sp is None:
+            return None
+        from jax.sharding import PartitionSpec as P
+        w, s = progs.wave_width, progs.num_slots
+        dtype = jnp.int32 if progs.int_scan else jnp.float32
+        fn = obs.track_jit(
+            "shard.psum_probe",
+            jax.jit(shard_map_compat(
+                lambda h: jax.lax.psum(h, sp.axis), self.mesh,
+                (P(sp.axis),), P())))
+        buf = jnp.zeros((sp.n_shards, w, s, 3), dtype)
+        jax.block_until_ready(fn(buf))
+        t0 = _time.perf_counter()
+        for _ in range(max(1, int(reps))):
+            r = fn(buf)
+        jax.block_until_ready(r)
+        ms = (_time.perf_counter() - t0) / max(1, int(reps)) * 1e3
+        obs.observe("shard.psum", ms / 1e3)
+        obs.set_gauge("shard.psum_ms", round(ms, 3))
+        return {"psum_ms": round(ms, 3)}
+
+    # ------------------------------------------------------------------
     def profile_phases(self, grad, hess, reps: int = 20) -> dict:
         """Honest per-phase attribution for one wave (bench --profile).
 
@@ -1431,6 +1761,12 @@ class DeviceGrower:
         """
         import time as _time
 
+        if self.programs.shard is not None:
+            from ..utils.log import log_warning
+            log_warning("profile_phases is unavailable under "
+                        "data_sharding (phase probes run outside the "
+                        "mesh); use profile_psum for collective time")
+            return {}
         w, n = self.wave_width, self.n_pad
         rng = np.random.default_rng(0)
         leaf_id = jnp.asarray(
@@ -1544,7 +1880,8 @@ class DeviceGrower:
         return out
 
 
-def device_growth_eligible(config, dataset, objective, num_model) -> bool:
+def device_growth_eligible(config, dataset, objective, num_model,
+                           n_shards: int = 1) -> bool:
     """Whether the dense device grower covers this training configuration.
     Anything it can't do falls back to the host-driven learner.
     Multiclass runs one grow dispatch per class; bagging/GOSS route a
@@ -1559,7 +1896,10 @@ def device_growth_eligible(config, dataset, objective, num_model) -> bool:
         return False
     # single f32 count columns are exact below COUNT_SPLIT_ROWS (2^24);
     # the striped two-column layout extends that to twice the threshold
-    # (the int8 path's striped int32 g/h accumulators share the bound)
-    if dataset.num_data >= 2 * COUNT_SPLIT_ROWS:
+    # (the int8 path's striped int32 g/h accumulators share the bound).
+    # The bound is per-ACCUMULATOR, i.e. per shard: a single-controller
+    # mesh grows the eligible global row count by its device count
+    # (cross-shard counts psum in int32, exact to 2^31).
+    if dataset.num_data >= max(int(n_shards), 1) * 2 * COUNT_SPLIT_ROWS:
         return False
     return True

@@ -44,14 +44,23 @@ def quant_scales(grad, hess, eps: float = 1e-30):
     return sg, sh
 
 
+def stochastic_round_with(x, scale, u):
+    """:func:`stochastic_round_int8` with the uniform noise supplied by
+    the caller — the sharded grower draws it at the canonical GLOBAL
+    shape and slices its shard's block (jax's threefry stream is keyed
+    on the draw shape, so per-row noise only matches the single-device
+    path when the drawn shape matches too)."""
+    q = jnp.floor(x / scale + u)
+    return jnp.clip(q, -QUANT_MAX, QUANT_MAX).astype(jnp.int8)
+
+
 def stochastic_round_int8(x, scale, key):
     """Unbiased stochastic rounding of ``x / scale`` to int8:
     ``floor(v + u)`` with u ~ U[0, 1) has expectation exactly v, so the
     quantization error is zero-mean noise the histogram bin sums average
     out (variance ~ rows_in_bin) instead of a systematic bias."""
-    u = jax.random.uniform(key, x.shape)
-    q = jnp.floor(x / scale + u)
-    return jnp.clip(q, -QUANT_MAX, QUANT_MAX).astype(jnp.int8)
+    return stochastic_round_with(x, scale,
+                                 jax.random.uniform(key, x.shape))
 
 
 def quantize_gh(grad, hess, key):

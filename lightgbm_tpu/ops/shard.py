@@ -1,0 +1,176 @@
+"""Single-controller data-parallel sharding for the device grower.
+
+The reference's Network layer (PAPER.md §1) powers its data-parallel
+tree learner with Allreduce/ReduceScatter collectives where the
+histogram reduction is the ONLY synchronization point per split.  The
+multiprocess worker mesh (``lightgbm_tpu/parallel/``) reproduces that
+faithfully but dispatches per-worker Python every step, which keeps it
+out of ``DeviceGrower.fused_train``'s K-trees-per-dispatch ``lax.scan``
+— and therefore out of every fused-path win (program cache, int8 MXU
+histograms, persisted stage plans).
+
+This module is the jax-native equivalent: ONE Python process shards the
+binned matrix (and every per-row buffer) row-wise across a device mesh
+with ``shard_map``, the existing fused scan runs unchanged on every
+chip, and a ``lax.psum`` of the wave histograms over the mesh axis is
+the sole cross-device communication of the growth loop (plus one (2,)
+``pmax`` per tree for the global quantization scale when
+``grad_quant_bits=8``).  Partition, traversal and leaf bookkeeping stay
+shard-local; find-best runs replicated on the globally-reduced
+histograms, so every device grows the identical tree — no split
+broadcast, exactly like the reference's data-parallel learner with
+``GLOBAL_data_count``.
+
+Row layout (the :class:`ShardSpec` contract)
+--------------------------------------------
+
+Global padded row space = ``n_shards * local_rows``; shard ``d`` owns
+the contiguous block ``[d * local_rows, (d + 1) * local_rows)`` and a
+real dataset row ``r`` lives at global padded index ``r`` — so shard
+``r // local_rows`` holds it.  Trailing shards may be mostly (or all)
+bucket padding; that costs nothing, because the grower's dense
+formulation processes every padded row regardless.  The traced global
+``num_valid`` scalar cuts validity per shard
+(``clip(num_valid - d * local_rows, 0, local_rows)``).
+
+Determinism / byte-identity contract (docs/Sharding.md)
+-------------------------------------------------------
+
+* ``grad_quant_bits=8`` under the int32 find-best scan: integer psum is
+  associative-exact, the quantization scale is a global ``pmax`` (max is
+  exact), the stochastic-rounding noise and the in-scan bagging mask
+  are drawn at CANONICAL GLOBAL shapes (``draw_npad`` / ``bag_npad`` —
+  jax's threefry draw is NOT prefix-stable across shapes, so the shape
+  itself is part of the stream) and sliced per shard, and the leaf
+  refit runs on exact int32 digit sums — so the sharded trainer emits
+  models BYTE-IDENTICAL to the single-device fused path.
+* f32 / bf16 histograms: the psum's reduction order is fixed by the
+  compiled program, so results are bit-reproducible run-to-run but not
+  bitwise equal to the single-device accumulation order.  Counts psum
+  as int32 either way, so row counts stay exact past 2^24 global rows.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import numpy as np
+
+from ..utils.log import LightGBMError, log_info
+
+#: the one mesh axis the sharded grower reduces over
+SHARD_AXIS = "shards"
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    """``shard_map`` across jax versions.
+
+    Newer jax exposes ``jax.shard_map`` with ``check_vma``; 0.4.x keeps
+    it under ``jax.experimental.shard_map`` with ``check_rep``.  Either
+    way replication checking is off: the grower's growth loop carries a
+    ``lax.while_loop`` whose replication rule old jax cannot derive, and
+    the replicated-output contract is enforced by the byte-identity
+    tests instead (tests/test_shard.py, scripts/check_shard.py).
+    """
+    smap = getattr(jax, "shard_map", None)
+    if smap is not None:
+        try:
+            return smap(fn, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, check_vma=False)
+        except TypeError:
+            # jax versions where jax.shard_map exists but still takes
+            # check_rep
+            return smap(fn, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, check_rep=False)
+    from jax.experimental.shard_map import shard_map as smap_exp
+    return smap_exp(fn, mesh=mesh, in_specs=in_specs,
+                    out_specs=out_specs, check_rep=False)
+
+
+class ShardSpec(NamedTuple):
+    """Static facts of one sharded-training layout (trace-level; joins
+    the grower program-cache signature via ``shard_signature``)."""
+
+    n_shards: int     #: mesh size D (always > 1; D == 1 runs unsharded)
+    axis: str         #: mesh axis name (SHARD_AXIS)
+    global_rows: int  #: REAL global row count (num_valid upper bound)
+    #: canonical global shape of the quantization-noise draw — the
+    #: single-device grower's chunk pad for ``global_rows``, so the
+    #: per-row rounding noise matches the unsharded path bit-for-bit
+    draw_npad: int
+    #: canonical global shape of the bagging uniform draw
+    #: (= ``histogram.bucket_size(global_rows)``, the same pad the
+    #: serial learner's bagging buffer uses)
+    bag_npad: int
+
+
+def local_valid_rows(spec: ShardSpec, local_rows: int, num_valid):
+    """Traced per-shard valid-row count: global rows are laid out in
+    contiguous ``local_rows`` blocks, so shard ``d`` is valid up to
+    ``num_valid - d * local_rows`` (clipped)."""
+    import jax.numpy as jnp
+    d = jax.lax.axis_index(spec.axis)
+    return jnp.clip(num_valid - d * local_rows, 0,
+                    local_rows).astype(jnp.int32)
+
+
+def slice_global_draw(spec: ShardSpec, full, local_rows: int):
+    """Take this shard's block of a canonically-shaped global draw.
+
+    ``full`` is a 1-D array drawn at a canonical global shape
+    (``draw_npad`` / ``bag_npad``); rows beyond it (only ever bucket
+    padding, zeroed by the valid mask) read as 0.
+    """
+    import jax.numpy as jnp
+    total = spec.n_shards * local_rows
+    if full.shape[0] >= total:
+        full = full[:total]
+    else:
+        full = jnp.pad(full, (0, total - full.shape[0]))
+    off = jax.lax.axis_index(spec.axis) * local_rows
+    return jax.lax.dynamic_slice(full, (off,), (local_rows,))
+
+
+def make_shard_mesh(num_devices: int = 0):
+    """One-axis ``SHARD_AXIS`` mesh over local devices (0 = all).
+
+    Raises :class:`LightGBMError` when fewer than 2 devices are
+    available — single-controller sharding with one device is exactly
+    the unsharded fused path, so callers fall back instead.
+    """
+    from jax.sharding import Mesh
+    devices = jax.devices()
+    d = int(num_devices) or len(devices)
+    if d < 2:
+        raise LightGBMError(
+            f"data_sharding=single_controller needs >= 2 devices, have "
+            f"{len(devices)} (request {d}); on CPU force a virtual mesh "
+            f"with XLA_FLAGS=--xla_force_host_platform_device_count=N")
+    if d > len(devices):
+        raise LightGBMError(
+            f"shard_devices={d} exceeds available devices "
+            f"({len(devices)})")
+    return Mesh(np.asarray(devices[:d]), (SHARD_AXIS,))
+
+
+def sharding_mode(config) -> str:
+    """Resolved ``data_sharding`` mode string ("off" when unset)."""
+    return str(getattr(config, "data_sharding", "off") or "off").lower()
+
+
+def resolve_shard_mesh(config) -> Optional[object]:
+    """Mesh for ``data_sharding=single_controller``, or None (off /
+    not enough devices — logged, training proceeds unsharded)."""
+    if sharding_mode(config) != "single_controller":
+        return None
+    try:
+        mesh = make_shard_mesh(int(getattr(config, "shard_devices", 0)
+                                   or 0))
+    except LightGBMError as e:
+        log_info(f"data_sharding=single_controller unavailable "
+                 f"({e}); training unsharded")
+        return None
+    log_info(f"data_sharding=single_controller: row-sharding over "
+             f"{mesh.devices.size} device(s), psum wave histograms")
+    return mesh

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import obs
 from ..ops.histogram import (_gather_rows, _histogram_scan, bucket_size,
                              num_chunks_for)
 from ..ops.partition import _partition_kernel
@@ -104,12 +105,6 @@ class DataParallelTreeLearner(SerialTreeLearner):
             net = self.net
             n_loc = self.n_loc
 
-            @jax.jit
-            @functools.partial(jax.shard_map, mesh=net.mesh,
-                               in_specs=(self._rep_spec, self._row_spec,
-                                         self._rep_spec),
-                               out_specs=(self._row_spec, self._row_spec),
-                               check_vma=False)
             def _bag(key, n_valid, frac):
                 w = jax.lax.axis_index(net.axis)
                 k = jax.random.fold_in(key, w)
@@ -122,7 +117,11 @@ class DataParallelTreeLearner(SerialTreeLearner):
                 return order.astype(jnp.int32), \
                     jnp.broadcast_to(selected.sum().astype(jnp.int32), (1,))
 
-            self._bag_fn = _bag
+            self._bag_fn = obs.track_jit("dp.bagging", jax.jit(
+                net.run_sharded(
+                    _bag,
+                    (self._rep_spec, self._row_spec, self._rep_spec),
+                    (self._row_spec, self._row_spec))))
         buf, counts = self._bag_fn(jax.random.PRNGKey(seed),
                                    self._n_valid_dev,
                                    jnp.asarray(fraction, jnp.float32))
@@ -140,14 +139,6 @@ class DataParallelTreeLearner(SerialTreeLearner):
             net = self.net
             n_loc = self.n_loc
 
-            @jax.jit
-            @functools.partial(jax.shard_map, mesh=net.mesh,
-                               in_specs=(self._rep_spec, self._row_spec,
-                                         self._row_spec, self._rep_spec,
-                                         self._rep_spec),
-                               out_specs=(self._row_spec, self._row_spec,
-                                          self._row_spec),
-                               check_vma=False)
             def _goss(key, score, n_valid, top_rate, other_rate):
                 w = jax.lax.axis_index(net.axis)
                 k = jax.random.fold_in(key, w)
@@ -182,7 +173,12 @@ class DataParallelTreeLearner(SerialTreeLearner):
                             selected.sum().astype(jnp.int32), (1,)),
                         mult)
 
-            self._goss_fn = _goss
+            self._goss_fn = obs.track_jit("dp.goss", jax.jit(
+                net.run_sharded(
+                    _goss,
+                    (self._rep_spec, self._row_spec, self._row_spec,
+                     self._rep_spec, self._rep_spec),
+                    (self._row_spec, self._row_spec, self._row_spec))))
         score_pad = self._pad_rows(jnp.asarray(score_abs, jnp.float32))
         buf, counts, mult = self._goss_fn(
             jax.random.PRNGKey(seed), score_pad, self._n_valid_dev,
@@ -225,13 +221,6 @@ class DataParallelTreeLearner(SerialTreeLearner):
         net, n_loc = self.net, self.n_loc
         num_chunks = num_chunks_for(m)
 
-        @jax.jit
-        @functools.partial(
-            jax.shard_map, mesh=net.mesh,
-            in_specs=(self._row2d_spec, self._row_spec, self._row_spec,
-                      self._row_spec, self._row2d_spec, self._row2d_spec,
-                      self._rep_spec),
-            out_specs=self._rep_spec, check_vma=False)
         def _hist(binned, grad, hess, buffer, lb, lc, leaf):
             begin = lb[0, leaf]
             count = lc[0, leaf]
@@ -243,6 +232,12 @@ class DataParallelTreeLearner(SerialTreeLearner):
             # the one collective per split: global histogram over ICI
             return net.allreduce(h)
 
+        _hist = obs.track_jit(f"dp.hist_m{m}", jax.jit(net.run_sharded(
+            _hist,
+            (self._row2d_spec, self._row_spec, self._row_spec,
+             self._row_spec, self._row2d_spec, self._row2d_spec,
+             self._rep_spec),
+            self._rep_spec)))
         self._hist_fns[m] = _hist
         return _hist
 
@@ -260,11 +255,6 @@ class DataParallelTreeLearner(SerialTreeLearner):
             self._row2d_spec
         rep = (self._rep_spec,) * 12
 
-        @jax.jit
-        @functools.partial(
-            jax.shard_map, mesh=net.mesh, in_specs=specs + rep,
-            out_specs=(self._row_spec, self._row2d_spec, self._row2d_spec),
-            check_vma=False)
         def _part(binned, buffer, lb2, lc2, leaf, right_leaf, group, offset,
                   width, default_bin, num_bin, missing, threshold,
                   default_left, is_cat, cat_member):
@@ -283,6 +273,10 @@ class DataParallelTreeLearner(SerialTreeLearner):
             lc = lc.at[leaf].set(left_cnt)
             return buffer, lb[None], lc[None]
 
+        _part = obs.track_jit(f"dp.partition_m{m}", jax.jit(
+            net.run_sharded(
+                _part, specs + rep,
+                (self._row_spec, self._row2d_spec, self._row2d_spec))))
         self._part_fns[m] = _part
         return _part
 
@@ -310,12 +304,6 @@ class DataParallelTreeLearner(SerialTreeLearner):
         if self._addend_fn is None:
             net, n_loc = self.net, self.n_loc
 
-            @jax.jit
-            @functools.partial(
-                jax.shard_map, mesh=net.mesh,
-                in_specs=(self._row_spec, self._row2d_spec, self._row2d_spec,
-                          self._rep_spec, self._rep_spec, self._rep_spec),
-                out_specs=self._row_spec, check_vma=False)
             def _addend(buffer, lb2, lc2, ids, vals, n_real):
                 lb, lc = lb2[0], lc2[0]
                 begins = lb[ids]
@@ -336,7 +324,12 @@ class DataParallelTreeLearner(SerialTreeLearner):
                 out = jnp.zeros(n_loc, jnp.float32)
                 return out.at[buffer].add(addend_pos)
 
-            self._addend_fn = _addend
+            self._addend_fn = obs.track_jit("dp.score_addend", jax.jit(
+                net.run_sharded(
+                    _addend,
+                    (self._row_spec, self._row2d_spec, self._row2d_spec,
+                     self._rep_spec, self._rep_spec, self._rep_spec),
+                    self._row_spec)))
         ids = sorted(self.leaves)
         pad_to = self._num_leaves
         ids_np = np.asarray(ids + [ids[0]] * (pad_to - len(ids)), np.int32)

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import obs
 from ..ops.histogram import _histogram_scan, num_chunks_for
 from ..ops.split import (F_FEATURE, F_GAIN, FeatureMeta,
                          find_best_split_impl)
@@ -86,11 +87,6 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
         n_rows = int(self._binned_cols.shape[0])
         num_chunks = num_chunks_for(m)
 
-        @jax.jit
-        @functools.partial(
-            jax.shard_map, mesh=net.mesh,
-            in_specs=(self._rep,) * 7,
-            out_specs=P(net.axis), check_vma=False)
         def _hist(binned_cols, grad, hess, buffer, begin, start, count):
             w = jax.lax.axis_index(net.axis)
             cols = jax.lax.dynamic_slice(
@@ -104,6 +100,8 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
             gh = jnp.stack([grad[idx] * vf, hess[idx] * vf, vf], axis=1)
             return _histogram_scan(bins, gh, num_chunks)   # (g_loc,256,3)
 
+        _hist = obs.track_jit(f"fp.hist_m{m}", jax.jit(net.run_sharded(
+            _hist, (self._rep,) * 7, P(net.axis))))
         self._hist_fns[m] = _hist
         return _hist
 
@@ -129,12 +127,6 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
                 lambda a: P(net.axis, *([None] * (a.ndim - 1))),
                 self._meta_sh)
 
-            @jax.jit
-            @functools.partial(
-                jax.shard_map, mesh=net.mesh,
-                in_specs=(P(net.axis), self._rep, self._rep, self._rep,
-                          meta_specs, self._rep),
-                out_specs=(self._rep, self._rep), check_vma=False)
             def _fb(hist_sh, total, constraint, fmask, meta2, hp):
                 meta = jax.tree_util.tree_map(lambda a: a[0], meta2)
                 flat = hist_sh.reshape(-1, 3)
@@ -161,7 +153,12 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
                     jnp.where(owner, cat.astype(jnp.float32), 0.0))
                 return packed_g, cat_g > 0.5
 
-            self._fb_fn = _fb
+            self._fb_fn = obs.track_jit("fp.find_best", jax.jit(
+                net.run_sharded(
+                    _fb,
+                    (P(net.axis), self._rep, self._rep, self._rep,
+                     meta_specs, self._rep),
+                    (self._rep, self._rep))))
         return self._fb_fn(info.hist,
                            jnp.asarray(info.total, jnp.float32),
                            jnp.asarray((info.cmin, info.cmax), jnp.float32),

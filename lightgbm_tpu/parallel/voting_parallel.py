@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from .. import obs
 from ..ops.split import (FeatureMeta, NEG_INF, feature_histograms,
                          gather_feature_histograms, masked_feature_gain,
                          min_gain_shift_of, pack_best, per_feature_best,
@@ -41,8 +42,8 @@ from .network import Network
 
 
 @functools.partial(jax.jit, static_argnames=("has_cat",))
-def _elected_best(fh_raw, total, constraint, feature_mask, eids, meta_e,
-                  hp, has_cat):
+def _elected_best_impl(fh_raw, total, constraint, feature_mask, eids,
+                       meta_e, hp, has_cat):
     """Final scan over the elected features' GLOBAL histograms."""
     fh = reconstruct_default(fh_raw, total, meta_e)
     shift = min_gain_shift_of(total, hp)
@@ -53,6 +54,9 @@ def _elected_best(fh_raw, total, constraint, feature_mask, eids, meta_e,
     gain = masked_feature_gain(pf, meta_e, mask_e, shift)
     best = jnp.argmax(gain)   # eids ascending => serial tie-break order
     return pack_best(best, gain, pf, total, constraint, hp, meta_e)
+
+
+_elected_best = obs.track_jit("vp.elected_best", _elected_best_impl)
 
 
 class VotingParallelTreeLearner(DataParallelTreeLearner):
@@ -85,14 +89,6 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
         net, n_loc = self.net, self.n_loc
         num_chunks = num_chunks_for(m)
 
-        @jax.jit
-        @functools.partial(
-            jax.shard_map, mesh=net.mesh,
-            in_specs=(self._row2d_spec, self._row_spec, self._row_spec,
-                      self._row_spec, self._row2d_spec, self._row2d_spec,
-                      self._rep_spec),
-            out_specs=(P(net.axis), self._row2d_spec, self._rep_spec),
-            check_vma=False)
         def _hist(binned, grad, hess, buffer, lb, lc, leaf):
             begin = lb[0, leaf]
             count = lc[0, leaf]
@@ -105,6 +101,12 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
             glob_tot = net.allreduce(loc_tot)
             return h, loc_tot[None], glob_tot
 
+        _hist = obs.track_jit(f"vp.hist_m{m}", jax.jit(net.run_sharded(
+            _hist,
+            (self._row2d_spec, self._row_spec, self._row_spec,
+             self._row_spec, self._row2d_spec, self._row2d_spec,
+             self._rep_spec),
+            (P(net.axis), self._row2d_spec, self._rep_spec))))
         self._local_hist_fns[m] = _hist
         return _hist
 
@@ -148,13 +150,6 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
             meta = self.ctx.meta
             k = self.k
 
-            @functools.partial(jax.jit, static_argnames=())
-            @functools.partial(
-                jax.shard_map, mesh=net.mesh,
-                in_specs=(P(net.axis), self._row2d_spec, self._rep_spec,
-                          self._rep_spec, self._rep_spec),
-                out_specs=(self._row2d_spec, self._row2d_spec),
-                check_vma=False)
             def _vote(h_sh, lt2, constraint, fmask, hp):
                 flat = h_sh.reshape(-1, 3)
                 tot = lt2[0]
@@ -166,7 +161,12 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
                 topg, topi = jax.lax.top_k(gains, k)
                 return topi[None].astype(jnp.int32), topg[None]
 
-            self._vote_fn = _vote
+            self._vote_fn = obs.track_jit("vp.local_vote", jax.jit(
+                net.run_sharded(
+                    _vote,
+                    (P(net.axis), self._row2d_spec, self._rep_spec,
+                     self._rep_spec, self._rep_spec),
+                    (self._row2d_spec, self._row2d_spec))))
 
         constraint = jnp.asarray((info.cmin, info.cmax), jnp.float32)
         ids, gains = self._vote_fn(hist_sh, loc_tot, constraint,
@@ -190,16 +190,13 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
             meta_rep = jax.tree_util.tree_map(lambda _: self._rep_spec,
                                               self.ctx.meta)
 
-            @jax.jit
-            @functools.partial(
-                jax.shard_map, mesh=net.mesh,
-                in_specs=(P(net.axis), meta_rep),
-                out_specs=self._rep_spec, check_vma=False)
             def _gather(h_sh, me):
                 fh_raw = gather_feature_histograms(h_sh.reshape(-1, 3), me)
                 return net.allreduce(fh_raw)
 
-            self._gather_fn = _gather
+            self._gather_fn = obs.track_jit("vp.gather_elected", jax.jit(
+                net.run_sharded(_gather, (P(net.axis), meta_rep),
+                                self._rep_spec)))
         fh_raw = self._gather_fn(hist_sh, meta_e)
 
         # -- stage 4: final scan on global histograms + global counts -----

@@ -568,6 +568,27 @@ PARAM_SCHEMA: Sequence[Param] = (
             "the pow2 bucket would cross the striped-count bound "
             "(datasets over 2^24 rows fall back to exact rows, logged). "
             "See docs/ColdStart.md", section="device"),
+    _p("data_sharding", str, "off", (),
+       check="off/single_controller",
+       desc="single-controller data-parallel training for the device "
+            "grower (docs/Sharding.md): single_controller row-shards "
+            "the binned matrix and every per-row buffer across a local "
+            "device mesh with shard_map from ONE process, runs the "
+            "fused K-trees-per-dispatch scan on all chips, and "
+            "psum-reduces the wave histograms over the mesh axis as "
+            "the growth loop's sole cross-device sync — find-best runs "
+            "replicated on the global histograms, so every device "
+            "grows the identical tree. Under grad_quant_bits=8's int32 "
+            "scan, models are BYTE-identical to the single-device "
+            "fused path; f32 histograms are bit-reproducible "
+            "run-to-run. Falls back (logged) to unsharded training "
+            "with fewer than 2 devices. off (default) = unsharded; the "
+            "multiprocess tree_learner=data/feature/voting mesh remains "
+            "the multi-host fallback", section="device"),
+    _p("shard_devices", int, 0, (), check=">= 0",
+       desc="device count for data_sharding=single_controller: the "
+            "first N local devices form the one-axis mesh; 0 (default) "
+            "= all local devices", section="device"),
     _p("compile_cache_dir", str, "", ("xla_cache_dir",),
        desc="directory for JAX's persistent XLA compilation cache "
             "(lightgbm_tpu.compile_cache): compiled executables are "

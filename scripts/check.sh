@@ -56,14 +56,17 @@ else
 fi
 
 # 2b. jaxlint with NO baseline over the modules that are debt-free
-#     today (stage-plan and the whole serve/, pipeline/ and robust/
-#     subsystems ship with zero findings): unlike step 2 — where a new
-#     finding in a file with baselined siblings still fails but the
-#     file's debt can only ratchet down — this step pins an absolute
-#     zero-findings contract for the listed files
+#     today (stage-plan, the sharding layer, the whole serve/,
+#     pipeline/, robust/ AND — since the PR-13 ratchet registered its
+#     11 shard_map jits and fixed the global_sum recompile hazard —
+#     parallel/): unlike step 2 — where a new finding in a file with
+#     baselined siblings still fails but the file's debt can only
+#     ratchet down — this step pins an absolute zero-findings contract
+#     for the listed files (only the 4 utils JL006 entries remain
+#     baselined repo-wide)
 step "jaxlint (zero-debt modules)" python -m lightgbm_tpu.tools.jaxlint \
     lightgbm_tpu/ops/stage_plan.py lightgbm_tpu/ops/hist_pallas.py \
-    lightgbm_tpu/serve \
+    lightgbm_tpu/ops/shard.py lightgbm_tpu/parallel lightgbm_tpu/serve \
     lightgbm_tpu/pipeline lightgbm_tpu/robust --no-baseline
 
 # 3. the telemetry schema validator validates itself
@@ -98,6 +101,13 @@ if [[ "${1:-}" != "--fast" ]]; then
     #     whole-training level, with the int32 find-best scan active
     #     (ROUND8_NOTES.md)
     step "quant smoke" python scripts/check_quant.py
+
+    # 5e. shard smoke: single-controller data-parallel training on a
+    #     forced 4-device host mesh must emit trees byte-identical to
+    #     the single-device fused path under grad_quant_bits=8, and a
+    #     warm same-shape retrain window must trace NOTHING new
+    #     (docs/Sharding.md)
+    step "shard smoke" python scripts/check_shard.py
 
     tier1() {
         rm -f /tmp/_t1.log

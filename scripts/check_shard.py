@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""CI shard smoke: single-controller sharded training byte-identity +
+zero-retrace warm window, on a forced 4-device host mesh.
+
+Gates (scripts/check.sh full mode; docs/Sharding.md contract):
+
+1. identity — with ``grad_quant_bits=8`` (int32 histogram scan, psum is
+   integer-exact) the 4-device sharded trainer emits trees
+   BYTE-identical to the single-device fused path, on both the fused
+   and the per-iteration dispatch paths;
+2. warm window — a second same-shape retrain window through a FRESH
+   booster traces NOTHING new (the grower program cache holds across
+   windows under sharding) and records a cache hit.
+
+The heavy lifting runs in tests/_shard_worker.py (XLA's forced device
+count must be set before jax initializes, hence the subprocess).  A
+shard-environment failure is reported as SKIP with the reason and exits
+0 — such failures in the CPU container are environmental (ROADMAP
+memory note); the contract is re-gated on real multi-chip by
+``bench.py --suite shard``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(os.path.dirname(HERE), "tests", "_shard_worker.py")
+
+
+def main() -> int:
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    proc = subprocess.run([sys.executable, WORKER, "core"], env=env,
+                          capture_output=True, text=True, timeout=540)
+    if proc.returncode != 0:
+        print(f"FAIL: shard worker rc={proc.returncode}\n"
+              f"{proc.stderr[-3000:]}")
+        return 1
+    out = None
+    for ln in reversed(proc.stdout.splitlines()):
+        try:
+            out = json.loads(ln)
+            break
+        except json.JSONDecodeError:
+            continue
+    if out is None:
+        print(f"FAIL: worker printed no JSON\n{proc.stdout[-2000:]}")
+        return 1
+    if "skip" in out:
+        print(f"SKIP: {out['skip']}")
+        return 0
+
+    checks = {
+        "trees byte-identical (fused, 1 vs 4 devices, int8)":
+            out.get("identity_fused") is True,
+        "trees byte-identical (per-iteration sharded path)":
+            out.get("identity_per_iter") is True,
+        "f32 sharded run-to-run deterministic":
+            out.get("f32_deterministic") is True,
+        "bagging+feature_fraction shard-invariant":
+            out.get("invariance_bag_ff") is True,
+        "warm same-shape window traced nothing new":
+            out.get("warm_window_new_compiles") == 0,
+        "warm window hit the program cache":
+            out.get("warm_window_cache_hit") is True,
+    }
+    ok = True
+    for name, passed in checks.items():
+        print(f"{'PASS' if passed else 'FAIL'}  {name}")
+        ok = ok and passed
+    digest = out.get("shard_digest")
+    if digest:
+        print(f"shard digest: devices={digest.get('devices')} "
+              f"local_rows={digest.get('local_rows')} "
+              f"sharded_dispatches={digest.get('sharded_dispatches')}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
