@@ -531,7 +531,7 @@ def run_serve(args) -> dict:
            "latency_p95_ms": round(
                float(np.percentile(lat_samples, 95)) * 1e3, 3)}
     pe = server.packed
-    return {
+    result = {
         "metric": f"serve_packed_{batch}row_batch_rows_per_sec",
         "value": round(batch * reps / timed_s, 0),
         "unit": "rows/s",
@@ -543,9 +543,79 @@ def run_serve(args) -> dict:
         "tree_pad": int(pe.split_feature.shape[0]),
         "depth_pad": pe.max_depth,
         "swap_same_shape": bool(same_shape),
-        "swap_retrace_zero": compiles_after == compiles_before,
+        "swap_retrace_zero": (compiles_after == compiles_before)
+        if obs.enabled() else None,
         "backend": jax.default_backend(),
         **lat,
+    }
+    if int(getattr(args, "models", 0)) > 1:
+        result["fleet"] = _run_fleet_leg(args, bst, xq, batch)
+    return result
+
+
+def _run_fleet_leg(args, bst, xq, batch) -> dict:
+    """--suite serve --models M: sustained mixed-tenant throughput over
+    an M-tenant FleetServer (every tenant seeded from the trained
+    booster — the arrays, gathers and conversion cost are what a real
+    fleet pays) plus the zero-retrace tenant hot-swap check.  The
+    1M+ rows/s verdict is chip-pending like BENCH_r06: the CPU
+    container records the numbers, the gate value needs the TPU run."""
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.serve import FleetServer
+
+    m = int(args.models)
+    replicas = int(os.environ.get("BENCH_SERVE_REPLICAS", "1")) or 1
+    fs = FleetServer([bst] * m, replicas=replicas)
+    t0 = time.perf_counter()
+    fs.warmup((512, batch))
+    warmup_s = time.perf_counter() - t0
+
+    rng = np.random.default_rng(12)
+    tids = rng.integers(0, m, batch).astype(np.int32)
+    reps = 8 if not args.quick else 4
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fs.predict(tids, xq)
+    timed_s = time.perf_counter() - t0
+    assert np.isfinite(np.asarray(out)).all()
+
+    lat_samples = []
+    for _ in range(32):
+        t1 = time.perf_counter()
+        fs.predict(tids[:512], xq[:512])
+        lat_samples.append(time.perf_counter() - t1)
+
+    # a tenant retrain hand-off must be a zero-retrace index write;
+    # without telemetry the check is unmeasured (null), never a
+    # vacuous 0 == 0 pass
+    snap = obs.registry().snapshot()["jit"] if obs.enabled() else {}
+    compiles_before = sum(v["compiles"] for v in snap.values())
+    fits = fs.swap_tenant(0, bst)
+    fs.predict(tids[:512], xq[:512])
+    snap = obs.registry().snapshot()["jit"] if obs.enabled() else {}
+    compiles_after = sum(v["compiles"] for v in snap.values())
+    retrace_zero = (compiles_after == compiles_before) \
+        if obs.enabled() else None
+
+    rows_per_s = batch * reps / timed_s
+    return {
+        "models": m,
+        "replicas": replicas,
+        "fleet_rows_per_s": round(rows_per_s, 0),
+        "batch_rows": batch,
+        "reps": reps,
+        "timed_s": round(timed_s, 3),
+        "warmup_s": round(warmup_s, 2),
+        "tree_pad": int(fs.fleet.tree_pad),
+        "fleet_latency_p50_ms": round(
+            float(np.percentile(lat_samples, 50)) * 1e3, 3),
+        "fleet_latency_p95_ms": round(
+            float(np.percentile(lat_samples, 95)) * 1e3, 3),
+        "tenant_swap_fits": bool(fits),
+        "tenant_swap_retrace_zero": retrace_zero,
+        # chip-pending gate (BENCH_r06 pattern): recorded on every
+        # backend, meaningful as a pass/fail only on the TPU driver
+        "pass_1m_rows_per_s": bool(rows_per_s >= 1.0e6),
     }
 
 
@@ -1066,6 +1136,12 @@ def main() -> int:
                          "~/.cache/lgbm_tpu_xla")
     ap.add_argument("--cache-admission", action="store_true",
                     help="alias for --suite cache")
+    ap.add_argument("--models", type=int,
+                    default=int(os.environ.get("BENCH_MODELS", "4")),
+                    help="--suite serve: tenant count M for the model-"
+                         "fleet leg (FleetServer: M stacked boosters, "
+                         "one jitted dispatch per mixed-tenant batch); "
+                         "<= 1 skips the fleet leg")
     ap.add_argument("--pipeline", action="store_true",
                     help="--suite cache: also run the harness through "
                          "the async windowed-retrain pipeline "

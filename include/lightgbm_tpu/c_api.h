@@ -132,6 +132,44 @@ LIGHTGBM_C_EXPORT int LGBM_ServePredictForCSR(
 LIGHTGBM_C_EXPORT int LGBM_ServeFree(ServeHandle handle);
 
 /* ---------------------------------------------------------------------
+ * Model fleet (lightgbm_tpu extension, not in the fork's ABI): M
+ * tenants stacked into ONE packed array family — a single jitted
+ * program serves any (tenant_ids, rows) batch, and a per-tenant
+ * retrain hands off via a zero-retrace device index write while the
+ * other tenants keep answering (docs/Serving.md "Model fleets").
+ * ------------------------------------------------------------------ */
+typedef void* FleetHandle;
+
+/* All num_tenants tenants start as copies of `booster`'s model;
+ * specialize them with LGBM_FleetSwapTenant.  Recognized parameters:
+ * num_iteration_predict, serve_replicas, fleet_value_dtype,
+ * serve_max_batch / serve_max_wait_ms. */
+LIGHTGBM_CPP_EXPORT int LGBM_FleetCreate(
+    const BoosterHandle booster, int num_tenants,
+    std::unordered_map<std::string, std::string> parameters,
+    FleetHandle* out);
+
+LIGHTGBM_C_EXPORT int LGBM_FleetSwapTenant(FleetHandle handle,
+                                           int tenant_id,
+                                           const BoosterHandle booster);
+
+LIGHTGBM_C_EXPORT int LGBM_FleetCalcNumPredict(FleetHandle handle,
+                                               int num_row,
+                                               int64_t* out_len);
+
+/* tenant_ids routes each CSR row to its tenant; num_tenant_ids == 1
+ * broadcasts one tenant to the whole batch.  predict_type:
+ * C_API_PREDICT_NORMAL or C_API_PREDICT_RAW_SCORE. */
+LIGHTGBM_C_EXPORT int LGBM_FleetPredictForCSR(
+    FleetHandle handle, const int32_t* tenant_ids,
+    int64_t num_tenant_ids, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t num_col, int predict_type,
+    int64_t* out_len, double* out_result);
+
+LIGHTGBM_C_EXPORT int LGBM_FleetFree(FleetHandle handle);
+
+/* ---------------------------------------------------------------------
  * AOT compile warmup (lightgbm_tpu extension, not in the fork's ABI):
  * precompile the declared (rows, features, parameters) training /
  * serving program families into the persistent XLA compile cache

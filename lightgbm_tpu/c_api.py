@@ -125,6 +125,16 @@ class _ServeEntry:
         self.server = server
 
 
+class _FleetEntry:
+    """A multi-tenant FleetServer behind an opaque handle
+    (lightgbm_tpu extension — LGBM_Fleet* functions)."""
+
+    __slots__ = ("server",)
+
+    def __init__(self, server):
+        self.server = server
+
+
 _handles: Dict[int, object] = {}
 _next_handle = 1
 # the serving setup is multi-threaded by design (PredictionServer micro-
@@ -147,7 +157,7 @@ def _unregister(handle) -> None:
 
 
 _HANDLE_KINDS = {_DatasetEntry: "Dataset", _BoosterEntry: "Booster",
-                 _ServeEntry: "Serve"}
+                 _ServeEntry: "Serve", _FleetEntry: "Fleet"}
 
 
 def _get(handle, cls):
@@ -661,6 +671,87 @@ def LGBM_ServePredictForCSR(serve_handle, indptr, indptr_type, indices,
 def LGBM_ServeFree(serve_handle):
     _get(serve_handle, _ServeEntry).server.stop()
     _unregister(serve_handle)
+
+
+# ---------------------------------------------------------------------------
+# Model-fleet functions (lightgbm_tpu extension, not in the reference
+# ABI): M tenants stacked into one packed array family behind an opaque
+# handle — one jitted program serves any (tenant_ids, rows) batch, a
+# tenant retrain hands off via a zero-retrace device index write
+# (docs/Serving.md "Model fleets").
+# ---------------------------------------------------------------------------
+
+
+@_api
+def LGBM_FleetCreate(booster_handle, num_tenants, parameters, out: Ref):
+    """Create a FleetServer with ``num_tenants`` tenants, all seeded
+    from ``booster_handle``'s current model (specialize them afterwards
+    with LGBM_FleetSwapTenant).  Recognized parameters:
+    ``num_iteration_predict`` (served slice), ``serve_replicas``,
+    ``fleet_value_dtype`` and the pass-through extras
+    ``serve_max_batch`` / ``serve_max_wait_ms``."""
+    b = _get(booster_handle, _BoosterEntry)
+    cfg = _parse_params(parameters)
+    from .serve import FleetServer
+    m = int(num_tenants)
+    if m < 1:
+        raise LightGBMError(f"num_tenants must be >= 1, got {m}")
+    server = FleetServer(
+        [b.gbdt] * m,
+        num_iteration=int(getattr(cfg, "num_iteration_predict", -1)),
+        replicas=int(getattr(cfg, "serve_replicas", 1)),
+        value_dtype=str(getattr(cfg, "fleet_value_dtype", "f32")),
+        max_batch=int(cfg.extra.get("serve_max_batch", 8192)),
+        max_wait_ms=float(cfg.extra.get("serve_max_wait_ms", 2.0)))
+    out.value = _register(_FleetEntry(server))
+
+
+@_api
+def LGBM_FleetSwapTenant(fleet_handle, tenant_id, booster_handle):
+    """Atomically point ONE tenant at ``booster_handle``'s current
+    model (the per-tenant retrain-window hand-off); the other tenants
+    keep serving throughout."""
+    f = _get(fleet_handle, _FleetEntry)
+    b = _get(booster_handle, _BoosterEntry)
+    f.server.swap_tenant(int(tenant_id), b.gbdt)
+
+
+@_api
+def LGBM_FleetCalcNumPredict(fleet_handle, num_row, out_len: Ref):
+    f = _get(fleet_handle, _FleetEntry)
+    out_len.value = int(num_row) * f.server.fleet.num_model
+
+
+@_api
+def LGBM_FleetPredictForCSR(fleet_handle, tenant_ids, num_tenant_ids,
+                            indptr, indptr_type, indices, data,
+                            data_type, nindptr, nelem, num_col,
+                            predict_type, out_len: Ref, out_result):
+    """Score CSR rows against the fleet in one packed device dispatch.
+    ``tenant_ids`` is an int32 array routing each row to its tenant;
+    ``num_tenant_ids == 1`` broadcasts one tenant to the whole batch.
+    Supports NORMAL and RAW_SCORE predict types."""
+    f = _get(fleet_handle, _FleetEntry)
+    if predict_type not in (C_API_PREDICT_NORMAL,
+                            C_API_PREDICT_RAW_SCORE):
+        raise LightGBMError("LGBM_FleetPredictForCSR supports NORMAL "
+                            "and RAW_SCORE predict types only")
+    tids = np.asarray(tenant_ids, np.int32).reshape(-1)
+    n_ids = int(num_tenant_ids)
+    tids = tids[:n_ids] if n_ids > 1 else int(tids[0])
+    mat = _densify_csr(indptr, indptr_type, indices, data, data_type,
+                       nindptr, num_col)
+    res = f.server.predict(
+        tids, mat, raw_score=(predict_type == C_API_PREDICT_RAW_SCORE))
+    flat = np.asarray(res, np.float64).reshape(-1)
+    out_result[:len(flat)] = flat
+    out_len.value = len(flat)
+
+
+@_api
+def LGBM_FleetFree(fleet_handle):
+    _get(fleet_handle, _FleetEntry).server.stop()
+    _unregister(fleet_handle)
 
 
 # ---------------------------------------------------------------------------

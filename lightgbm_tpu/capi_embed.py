@@ -176,6 +176,53 @@ def serve_free(serve_handle):
     _call(C.LGBM_ServeFree, serve_handle)
 
 
+def fleet_create(booster_handle, num_tenants, params):
+    out = C.Ref()
+    with obs.span("capi.fleet_create", cat="capi",
+                  tenants=int(num_tenants)):
+        _call(C.LGBM_FleetCreate, booster_handle, int(num_tenants),
+              params, out)
+    return int(out.value)
+
+
+def fleet_swap_tenant(fleet_handle, tenant_id, booster_handle):
+    # one per-tenant swap per retrain window: the fleet index-writes the
+    # freshly trained booster while the other tenants keep serving
+    with obs.span("capi.fleet_swap_tenant", cat="capi",
+                  tenant=int(tenant_id)):
+        _call(C.LGBM_FleetSwapTenant, fleet_handle, int(tenant_id),
+              booster_handle)
+
+
+def fleet_calc_num_predict(fleet_handle, num_row):
+    out = C.Ref()
+    _call(C.LGBM_FleetCalcNumPredict, fleet_handle, int(num_row), out)
+    return int(out.value)
+
+
+def fleet_predict_for_csr(fleet_handle, tenant_ids_mv, num_tenant_ids,
+                          indptr_mv, indptr_type, indices_mv, data_mv,
+                          data_type, nindptr, nelem, num_col,
+                          predict_type, out_mv):
+    out_len = C.Ref()
+    out_arr = np.frombuffer(out_mv, np.float64)
+    with obs.span("capi.fleet_predict_for_csr", cat="capi",
+                  rows=int(nindptr) - 1):
+        _call(C.LGBM_FleetPredictForCSR, fleet_handle,
+              _arr(tenant_ids_mv, C.C_API_DTYPE_INT32),
+              int(num_tenant_ids),
+              _arr(indptr_mv, indptr_type), indptr_type,
+              _arr(indices_mv, C.C_API_DTYPE_INT32),
+              _arr(data_mv, data_type), data_type,
+              int(nindptr), int(nelem), int(num_col), predict_type,
+              out_len, out_arr)
+    return int(out_len.value)
+
+
+def fleet_free(fleet_handle):
+    _call(C.LGBM_FleetFree, fleet_handle)
+
+
 def warmup_train(params, num_row, num_feature):
     out = C.Ref()
     with obs.span("capi.warmup_train", cat="capi", rows=int(num_row)):

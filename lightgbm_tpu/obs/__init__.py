@@ -318,6 +318,23 @@ def summary() -> Dict:
             "swaps": snap["counters"].get("serve.swaps", 0),
             "rows": snap["counters"].get("serve.rows", 0),
         }
+    fleet_stat = snap["timings"].get("serve.fleet.predict")
+    if fleet_stat:
+        out["fleet"] = {
+            "predicts": fleet_stat["count"],
+            "predict_p50_ms": round(fleet_stat["p50_s"] * 1e3, 3),
+            "predict_p95_ms": round(fleet_stat["p95_s"] * 1e3, 3),
+            "tenants": snap["gauges"].get("serve.fleet.tenants"),
+            "replicas": snap["gauges"].get("serve.fleet.replicas"),
+            "swaps": snap["counters"].get("serve.fleet.swaps", 0),
+            "swap_shape_changes": snap["counters"].get(
+                "serve.fleet.swap_shape_changes", 0),
+            "rows": snap["counters"].get("serve.fleet.rows", 0),
+            "fallback_requests": snap["counters"].get(
+                "serve.fleet.fallback_requests", 0),
+            "degraded_replicas": snap["gauges"].get(
+                "serve.fleet.degraded_replicas"),
+        }
     shard_devices = snap["gauges"].get("shard.devices")
     if shard_devices:
         # single-controller sharded training ran: attribute collective

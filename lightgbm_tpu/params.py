@@ -552,6 +552,26 @@ PARAM_SCHEMA: Sequence[Param] = (
             "on throughput. Tune per deployment; the PredictionServer "
             "(lightgbm_tpu.serve) always uses the device kernel",
        section="device"),
+    _p("serve_replicas", int, 1, (), check=">= 0",
+       desc="device replicas for multi-tenant fleet serving "
+            "(lightgbm_tpu.serve.FleetServer / LGBM_FleetCreate): the "
+            "packed fleet arrays are copied onto this many local "
+            "devices and request micro-batch queues round-robin across "
+            "them, each replica degrading to the host tree walk "
+            "independently through its own circuit breaker "
+            "(docs/Serving.md). 0 = one replica per local device; 1 "
+            "(default) = single-device serving", section="device"),
+    _p("fleet_value_dtype", str, "f32", (),
+       check="f32/bf16",
+       desc="leaf-value storage dtype of the packed model fleet "
+            "(lightgbm_tpu.serve.FleetServer): f32 (default) serves "
+            "byte-identical to each tenant's solo PackedEnsemble; bf16 "
+            "halves the leaf-table bytes for inference throughput — "
+            "leaf ROUTING stays exact (the hi/lo threshold compare is "
+            "untouched), only the accumulated VALUES quantize to ~3 "
+            "decimal digits, mirroring the training-side int8 contract "
+            "(routing exact, values quantize; docs/Serving.md)",
+       section="device"),
     _p("train_row_bucketing", bool, True, ("row_bucketing",),
        desc="pad the training row count to a pow2 bucket (ops/histogram."
             "bucket_size, min 1024 — the same ladder the bagging buffer "

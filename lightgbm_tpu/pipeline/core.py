@@ -176,6 +176,7 @@ class RetrainPipeline:
                  pipelined: bool = True,
                  serve: bool = True,
                  server=None,
+                 tenant_id: Optional[int] = None,
                  eval_chunk_rows: int = 65536,
                  warmup_rows="auto",
                  keep_boosters: bool = True,
@@ -226,6 +227,20 @@ class RetrainPipeline:
         if serve and self.server is None:
             from ..serve.engine import PredictionServer
             self.server = PredictionServer()
+        if tenant_id is not None:
+            # tenant-aware swap target (docs/Serving.md): the pipeline
+            # retrains ONE tenant of a FleetServer — every swap/eval
+            # lands on that tenant while the fleet's other tenants keep
+            # serving from the same compiled programs
+            if self.server is None:
+                raise LightGBMError(
+                    "tenant_id= needs a serving target; pass server= "
+                    "(a FleetServer) and keep serve=True")
+            if not hasattr(self.server, "tenant"):
+                raise LightGBMError(
+                    "tenant_id= needs a multi-tenant server (a "
+                    "FleetServer or anything exposing .tenant())")
+            self.server = self.server.tenant(int(tenant_id))
         self.warmup_rows = warmup_rows
         # False = drop each WindowResult's booster reference after
         # on_window fires (long service loops would otherwise pin every
@@ -482,7 +497,10 @@ class RetrainPipeline:
         same = self.server.swap(bst)
         swap_s = time.perf_counter() - t0
         obs.observe("pipeline.swap", swap_s)
-        if first and not self._warmed:
+        # a fleet TenantHandle always has a model (the fleet seeds every
+        # tenant), so warm on the first swap of THIS pipeline, not only
+        # when the server was empty
+        if not self._warmed:
             self._warmed = True
             rows = self.warmup_rows
             if rows == "auto":

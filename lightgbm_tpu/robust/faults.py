@@ -61,7 +61,8 @@ ENV_VAR = "LGBM_TPU_FAULTS"
 
 #: sites production code is instrumented with (typo guard at configure)
 KNOWN_SITES = (
-    "grow.dispatch", "serve.dispatch", "pipeline.prep", "pipeline.train",
+    "grow.dispatch", "serve.dispatch", "serve.fleet.dispatch",
+    "pipeline.prep", "pipeline.train",
     "net.connect", "net.send", "net.recv", "io.read", "io.write",
     "stream.parse",
 )

@@ -10,8 +10,13 @@ optional micro-batching, warmup precompiles and atomic model
 """
 
 from .engine import PredictionServer  # noqa: F401
+from .fleet import (FleetServer, PackedFleet, TenantHandle,  # noqa: F401
+                    fleet_predict_leaves, fleet_predict_scores,
+                    pack_fleet)
 from .packed import (PackedEnsemble, pack_ensemble, pack_gbdt,  # noqa: F401
                      predict_leaves, predict_scores, row_bucket)
 
 __all__ = ["PredictionServer", "PackedEnsemble", "pack_ensemble",
-           "pack_gbdt", "predict_leaves", "predict_scores", "row_bucket"]
+           "pack_gbdt", "predict_leaves", "predict_scores", "row_bucket",
+           "FleetServer", "PackedFleet", "TenantHandle", "pack_fleet",
+           "fleet_predict_scores", "fleet_predict_leaves"]

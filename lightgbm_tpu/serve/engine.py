@@ -88,29 +88,34 @@ def _as_gbdt(booster):
     return getattr(booster, "_gbdt", booster)
 
 
-class _Model:
-    """One immutable generation of the served model: the packed
-    ensemble, the output conversion the booster would apply, and (for
-    the degrade path) the host ``Tree`` objects of the SAME served
-    slice so a dead device never drops a request."""
+class ModelMeta:
+    """The booster-level facts of one served model generation that are
+    independent of WHERE its packed tables live (a solo
+    :class:`~.packed.PackedEnsemble` or one tenant row of a
+    :class:`~.fleet.PackedFleet`): the output conversion
+    ``Booster.predict`` would apply, and (for the degrade path) the
+    host ``Tree`` objects of the SAME served slice so a dead device
+    never drops a request."""
 
-    __slots__ = ("packed", "objective", "objective_str", "average_output",
+    __slots__ = ("objective", "objective_str", "average_output",
                  "n_iters", "host_trees", "num_model")
 
-    def __init__(self, packed: PackedEnsemble, gbdt, host_trees=None):
-        self.packed = packed
+    def __init__(self, gbdt, n_iters: int, host_trees=None,
+                 num_model: int = 1):
         self.objective = gbdt.objective
         self.objective_str = gbdt.loaded_objective_str
         self.average_output = bool(gbdt.average_output)
-        self.n_iters = packed.num_iterations
+        self.n_iters = int(n_iters)
         self.host_trees = host_trees
-        self.num_model = max(int(packed.num_model), 1)
+        self.num_model = max(int(num_model), 1)
 
     def host_raw(self, data: np.ndarray) -> np.ndarray:
         """(K, rows) float64 raw scores via the host tree walk — the
         exact accumulation ``GBDT.predict_raw``'s host path performs
         over this slice, so fallback answers match ``Booster.predict``
-        byte for byte."""
+        byte for byte.  Trees interleave iteration-major
+        (``out[i % num_model]``), the same order ``pack_ensemble``
+        lays the packed tree axis out in."""
         out = np.zeros((self.num_model, data.shape[0]), np.float64)
         for i, tree in enumerate(self.host_trees):
             out[i % self.num_model] += tree.predict(data)
@@ -130,6 +135,18 @@ class _Model:
         if raw.shape[0] == 1:
             return raw[0]
         return raw.T
+
+
+class _Model(ModelMeta):
+    """One immutable generation of the solo server's model: the packed
+    ensemble plus its :class:`ModelMeta`."""
+
+    __slots__ = ("packed",)
+
+    def __init__(self, packed: PackedEnsemble, gbdt, host_trees=None):
+        super().__init__(gbdt, packed.num_iterations, host_trees,
+                         packed.num_model)
+        self.packed = packed
 
 
 class PredictionServer:
@@ -349,9 +366,13 @@ class PredictionServer:
         with self._lock:
             worker = self._worker
             self._worker = None
+            # set the flag INSIDE the lock: submit() holds it across
+            # its liveness check + enqueue, so a request accepted
+            # concurrently with stop() still lands in a queue the
+            # worker drains before exiting
+            self._stopping.set()
         if worker is None:
             return
-        self._stopping.set()
         worker.join(timeout=10.0)
 
     def __enter__(self) -> "PredictionServer":
@@ -364,12 +385,15 @@ class PredictionServer:
     def submit(self, data, raw_score: bool = False) -> Future:
         """Enqueue rows for micro-batched prediction; resolves to the
         same values ``predict`` would return for those rows."""
-        if self._worker is None or not self._worker.is_alive():
-            raise LightGBMError("micro-batching worker not running; "
-                                "call start() (or use predict())")
         fut: Future = Future()
         data = np.atleast_2d(np.asarray(data, np.float64))
-        self._queue.put((data, bool(raw_score), fut, time.perf_counter()))
+        with self._lock:
+            if (self._stopping.is_set() or self._worker is None
+                    or not self._worker.is_alive()):
+                raise LightGBMError("micro-batching worker not running; "
+                                    "call start() (or use predict())")
+            self._queue.put((data, bool(raw_score), fut,
+                             time.perf_counter()))
         return fut
 
     def _drain_loop(self) -> None:

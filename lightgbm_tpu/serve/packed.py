@@ -268,13 +268,14 @@ _K_ZERO = np.float32(K_ZERO_THRESHOLD)
 _CAT_CLIP = np.float32(2.0e9)
 
 
-def _decide(pe: PackedEnsemble, cur, vhi, vlo):
-    """goes-left per (row, tree) — mirrors ``Tree._decision_matrix``
-    (missing modes, zero threshold, categorical bitsets) over the
-    packed layout.  ``cur`` is the (R, T) node index, ``vhi``/``vlo``
-    the gathered hi/lo query values."""
-    t_ix = jnp.arange(cur.shape[1], dtype=jnp.int32)[None, :]
-    dt = pe.decision_type[t_ix, cur]
+def route_left(dt, thi, tlo, cat_len, fetch_word, vhi, vlo):
+    """goes-left from per-(row, tree) GATHERED node tables — the one
+    implementation of the reference decision semantics (missing modes,
+    zero threshold, hi/lo lexicographic compare, categorical bitsets),
+    shared by the solo kernel below and the fleet kernel
+    (``serve/fleet.py``) so the two can never route differently.
+    ``fetch_word(widx)`` gathers the categorical bitset word at an
+    already-clipped in-range word index."""
     is_cat = (dt & K_CATEGORICAL_MASK) != 0
     default_left = (dt & K_DEFAULT_LEFT_MASK) != 0
     missing = (dt >> 2) & 3
@@ -283,8 +284,6 @@ def _decide(pe: PackedEnsemble, cur, vhi, vlo):
     zlo = jnp.where(nan_v, jnp.float32(0), vlo)
     is_miss = ((missing == 1) & (jnp.abs(zhi) <= _K_ZERO)) \
         | ((missing == 2) & nan_v)
-    thi = pe.threshold_hi[t_ix, cur]
-    tlo = pe.threshold_lo[t_ix, cur]
     le = (zhi < thi) | ((zhi == thi) & (zlo <= tlo))
     left_num = jnp.where(is_miss, default_left, le)
 
@@ -300,12 +299,25 @@ def _decide(pe: PackedEnsemble, cur, vhi, vlo):
         + (integral & (zc < 0) & (zlo > 0)).astype(jnp.int32)
     iv = jnp.where(nan_v, jnp.where(missing == 2, -1, 0), iv)
     widx = iv >> 5
-    in_range = (iv >= 0) & (widx < pe.cat_len[t_ix, cur])
-    word = pe.cat_words[pe.cat_start[t_ix, cur]
-                        + jnp.where(in_range, widx, 0)]
+    in_range = (iv >= 0) & (widx < cat_len)
+    word = fetch_word(jnp.where(in_range, widx, 0))
     bit = ((word >> (iv & 31).astype(jnp.uint32)) & 1) == 1
     left_cat = in_range & bit
     return jnp.where(is_cat, left_cat, left_num)
+
+
+def _decide(pe: PackedEnsemble, cur, vhi, vlo):
+    """goes-left per (row, tree) — mirrors ``Tree._decision_matrix``
+    (missing modes, zero threshold, categorical bitsets) over the
+    packed layout.  ``cur`` is the (R, T) node index, ``vhi``/``vlo``
+    the gathered hi/lo query values."""
+    t_ix = jnp.arange(cur.shape[1], dtype=jnp.int32)[None, :]
+    return route_left(
+        pe.decision_type[t_ix, cur],
+        pe.threshold_hi[t_ix, cur], pe.threshold_lo[t_ix, cur],
+        pe.cat_len[t_ix, cur],
+        lambda widx: pe.cat_words[pe.cat_start[t_ix, cur] + widx],
+        vhi, vlo)
 
 
 def _traverse(pe: PackedEnsemble, xhi, xlo):

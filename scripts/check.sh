@@ -90,6 +90,13 @@ if [[ "${1:-}" != "--fast" ]]; then
     #     answers mid-train, swaps stay shape-stable (docs/Pipeline.md)
     step "pipeline smoke" python scripts/check_pipeline.py
 
+    # 5b2. fleet smoke: a 3-tenant FleetServer retrains tenant 0
+    #      through the pipeline while tenants 1..2 serve — zero-retrace
+    #      index-write swaps, >=1 successful serve strictly during the
+    #      retrain, every probe byte-identical to the untouched
+    #      tenants' solo servers (docs/Serving.md "Model fleets")
+    step "fleet smoke" python scripts/check_fleet.py
+
     # 5c. chaos smoke: a mid-stream kill (injected prep fault) resumes
     #     from the per-window checkpoint to a byte-identical final
     #     model, and serving under injected device death answers every

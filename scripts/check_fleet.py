@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""CI smoke for multi-tenant fleet serving (docs/Serving.md "Model
+fleets").
+
+Builds a 3-tenant ``FleetServer``, retrains tenant 0 through the async
+windowed-retrain pipeline (``RetrainPipeline(server=fleet,
+tenant_id=0)``) while a prober hammers tenants 1 and 2, and gates the
+three contracts the subsystem exists for:
+
+1. **Zero-retrace tenant swap**: after the fleet warmup (which also
+   compiles the index-write program) and window 0, every later window's
+   swap must land as a device index write into already-compiled
+   programs — the obs-tracked jit compile total must not move, and
+   every swap must report ``fits`` (``swap_same_shape=True``).
+
+2. **Serving on the untouched tenants never stops**: every probe on
+   tenants 1..M-1 must succeed, and at least one must land strictly
+   INSIDE a later window's training interval of tenant 0's retrain.
+
+3. **Byte-identity vs solo servers**: tenants 1..M-1 are never
+   swapped, so every probe answer must be byte-identical to the
+   reference captured from each tenant's solo ``PredictionServer``
+   before the run.
+
+Exit 0 on success, 1 with a diagnostic on failure.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+WINDOW_ROWS = 5000
+FEATURES = 10
+WINDOWS = 3
+TENANTS = 3
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+          "min_data_in_leaf": 5, "verbosity": -1, "metric": "none",
+          "device_growth": "on", "num_iterations": 6, "max_depth": 6}
+
+
+def main() -> int:
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data.dataset import BinnedDataset
+    from lightgbm_tpu.pipeline import PreppedWindow, RetrainPipeline
+    from lightgbm_tpu.serve import FleetServer, PredictionServer
+
+    obs.configure(enabled=True)
+
+    def train(seed):
+        rng = np.random.default_rng(seed)
+        x = rng.standard_normal((WINDOW_ROWS, FEATURES))
+        y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float32)
+        cfg = Config(PARAMS)
+        ds = BinnedDataset.construct_from_matrix(x, cfg)
+        ds.metadata.set_label(y)
+        bst = create_boosting(cfg)
+        bst.init_train(ds)
+        bst.train_chunked(PARAMS["num_iterations"], chunk=3)
+        bst._flush_pending()
+        return bst
+
+    tenants = [train(100 + m) for m in range(TENANTS)]
+    fleet = FleetServer(tenants)
+    probe_rows = np.zeros((128, FEATURES))
+    probe_rows[:, 0] = np.linspace(-2, 2, 128)
+    # byte-identity reference: the untouched tenants' solo servers
+    solo_ref = [np.asarray(PredictionServer(tenants[m]).predict(
+        probe_rows)) for m in range(TENANTS)]
+    fleet.warmup([probe_rows.shape[0]])
+
+    probe_log = []          # (timestamp, ok, byte_identical)
+    probe_stop = threading.Event()
+
+    def prober():
+        while not probe_stop.is_set():
+            t = time.perf_counter()
+            try:
+                ok, ident = True, True
+                for m in range(1, TENANTS):
+                    out = np.asarray(fleet.predict(m, probe_rows))
+                    ok &= bool(np.isfinite(out).all())
+                    ident &= bool(np.array_equal(out, solo_ref[m]))
+            except Exception:   # noqa: BLE001 — the smoke records it
+                ok = ident = False
+            probe_log.append((t, ok, ident))
+            time.sleep(0.02)
+
+    def compiles_now():
+        return sum(v["compiles"]
+                   for v in obs.registry().snapshot()["jit"].values())
+
+    def prep(w):
+        rng = np.random.default_rng(1000 + w)
+        x = rng.standard_normal((WINDOW_ROWS, FEATURES))
+        y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float64)
+        return PreppedWindow(label=y, dense=x, eval_dense=x,
+                             eval_label=y)
+
+    pipe = RetrainPipeline(PARAMS, chunk=3, server=fleet, tenant_id=0)
+
+    state = {"compiles_after_w0": None, "prober": None}
+
+    def on_window(res):
+        if res.window == 0:
+            state["compiles_after_w0"] = compiles_now()
+            t = threading.Thread(target=prober, daemon=True)
+            t.start()
+            state["prober"] = t
+
+    try:
+        results = pipe.run(range(WINDOWS), prep, on_window=on_window)
+    finally:
+        probe_stop.set()
+        if state["prober"] is not None:
+            state["prober"].join(timeout=5.0)
+
+    failures = []
+    compiles_end = compiles_now()
+    if state["compiles_after_w0"] is None:
+        failures.append("window 0 never completed")
+    elif compiles_end != state["compiles_after_w0"]:
+        snap = obs.registry().snapshot()["jit"]
+        failures.append(
+            f"tenant swaps retraced: jit compiles went "
+            f"{state['compiles_after_w0']} -> {compiles_end} ({snap})")
+
+    if len(results) != WINDOWS:
+        failures.append(f"expected {WINDOWS} windows, got {len(results)}")
+    for res in results:
+        if res.swap_same_shape is False:
+            failures.append(f"window {res.window} tenant swap did not "
+                            f"fit the fleet pads (index write degraded "
+                            f"to a re-pack)")
+
+    if not probe_log:
+        failures.append("prober made no requests")
+    else:
+        if not all(ok for _, ok, _ in probe_log):
+            bad = sum(1 for _, ok, _ in probe_log if not ok)
+            failures.append(f"{bad}/{len(probe_log)} fleet probes "
+                            f"failed on the untouched tenants")
+        if not all(ident for _, _, ident in probe_log):
+            bad = sum(1 for _, _, ident in probe_log if not ident)
+            failures.append(
+                f"{bad}/{len(probe_log)} probes were NOT byte-identical "
+                f"to the untouched tenants' solo servers")
+        spans = [r.train_span for r in results[1:]]
+        mid_train = sum(1 for t, ok, _ in probe_log
+                        if ok and any(t0 <= t <= t1 for t0, t1 in spans))
+        if mid_train == 0:
+            failures.append("no fleet probe succeeded during tenant 0's "
+                            "retrain (serve-through-retrain not "
+                            "demonstrated)")
+
+    summary = {
+        "tenants": TENANTS,
+        "windows": len(results),
+        "compiles_after_w0": state["compiles_after_w0"],
+        "compiles_end": compiles_end,
+        "probes": len(probe_log),
+        "mid_train_probes": sum(
+            1 for t, ok, _ in probe_log
+            if ok and any(t0 <= t <= t1
+                          for t0, t1 in (r.train_span
+                                         for r in results[1:]))),
+        "swap_fits": [r.swap_same_shape for r in results],
+        "degraded_replicas": fleet.degraded_replicas(),
+    }
+    print(json.dumps(summary))
+    if failures:
+        for f in failures:
+            print(f"FLEET SMOKE FAIL: {f}", file=sys.stderr)
+        return 1
+    print(f"fleet smoke PASS: zero-retrace tenant swaps, "
+          f"{summary['mid_train_probes']} mid-retrain serves on "
+          f"untouched tenants, all probes byte-identical to solo")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

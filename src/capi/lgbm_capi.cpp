@@ -419,6 +419,83 @@ extern "C" int LGBM_ServeFree(ServeHandle handle) {
 }
 
 /* ------------------------------------------------------------------ */
+/* Model fleet (lightgbm_tpu extension)                                */
+/* ------------------------------------------------------------------ */
+
+int LGBM_FleetCreate(
+    const BoosterHandle booster, int num_tenants,
+    std::unordered_map<std::string, std::string> parameters,
+    FleetHandle* out) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(Lis)", static_cast<long long>(as_id(booster)), num_tenants,
+      params_string(parameters).c_str());
+  return handle_result(call_adapter("fleet_create", args), out);
+}
+
+extern "C" int LGBM_FleetSwapTenant(FleetHandle handle, int tenant_id,
+                                    const BoosterHandle booster) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(LiL)", static_cast<long long>(as_id(handle)), tenant_id,
+      static_cast<long long>(as_id(booster)));
+  return none_result(call_adapter("fleet_swap_tenant", args));
+}
+
+extern "C" int LGBM_FleetCalcNumPredict(FleetHandle handle, int num_row,
+                                        int64_t* out_len) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(Li)", static_cast<long long>(as_id(handle)), num_row);
+  return int_result(call_adapter("fleet_calc_num_predict", args),
+                    out_len);
+}
+
+extern "C" int LGBM_FleetPredictForCSR(
+    FleetHandle handle, const int32_t* tenant_ids,
+    int64_t num_tenant_ids, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t num_col, int predict_type,
+    int64_t* out_len, double* out_result) {
+  ensure_python();
+  Gil gil;
+  /* the caller pre-allocated out_result to FleetCalcNumPredict's len */
+  int64_t out_cap = 0;
+  {
+    PyObject* cargs = Py_BuildValue(
+        "(Li)", static_cast<long long>(as_id(handle)),
+        static_cast<int>(nindptr - 1));
+    if (int_result(call_adapter("fleet_calc_num_predict", cargs),
+                   &out_cap) != 0) {
+      return -1;
+    }
+  }
+  PyObject* args = Py_BuildValue(
+      "(LNLNiNNiLLLiN)", static_cast<long long>(as_id(handle)),
+      mv_read(tenant_ids, num_tenant_ids * 4),
+      static_cast<long long>(num_tenant_ids),
+      mv_read(indptr, nindptr * dtype_size(indptr_type)), indptr_type,
+      mv_read(indices, nelem * 4),
+      mv_read(data, nelem * dtype_size(data_type)), data_type,
+      static_cast<long long>(nindptr), static_cast<long long>(nelem),
+      static_cast<long long>(num_col), predict_type,
+      mv_write(out_result, out_cap * 8));
+  return int_result(call_adapter("fleet_predict_for_csr", args),
+                    out_len);
+}
+
+extern "C" int LGBM_FleetFree(FleetHandle handle) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(L)",
+                                 static_cast<long long>(as_id(handle)));
+  return none_result(call_adapter("fleet_free", args));
+}
+
+/* ------------------------------------------------------------------ */
 /* AOT compile warmup (lightgbm_tpu extension)                         */
 /* ------------------------------------------------------------------ */
 

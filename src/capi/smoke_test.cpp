@@ -213,6 +213,39 @@ int main() {
       }
     }
     std::printf("window %d: serve predict matches booster\n", window);
+
+    /* model fleet: 2 tenants seeded/swapped from the same booster must
+     * answer a mixed-tenant batch exactly like the solo server */
+    if (window == 1) {
+      FleetHandle fleet = nullptr;
+      check(LGBM_FleetCreate(booster, 2, trainParams, &fleet),
+            "FleetCreate");
+      check(LGBM_FleetSwapTenant(fleet, 1, booster), "FleetSwapTenant");
+      std::vector<int32_t> tenantIds(rows);
+      for (int i = 0; i < rows; i++) tenantIds[i] = i % 2;
+      int64_t flen = 0;
+      check(LGBM_FleetCalcNumPredict(fleet, rows, &flen),
+            "FleetCalcNumPredict");
+      std::vector<double> fresult(flen);
+      check(LGBM_FleetPredictForCSR(
+                fleet, tenantIds.data(), rows,
+                static_cast<void*>(indptr.data()), C_API_DTYPE_INT32,
+                indices.data(), static_cast<void*>(data.data()),
+                C_API_DTYPE_FLOAT64, indptr.size(), data.size(),
+                HISTFEATURES + 3, C_API_PREDICT_NORMAL, &flen,
+                fresult.data()),
+            "FleetPredictForCSR");
+      for (int i = 0; i < rows; i++) {
+        if (std::fabs(fresult[i] - sresult[i]) > 1e-12) {
+          std::fprintf(stderr,
+                       "FAIL fleet/serve mismatch at %d: %f vs %f\n",
+                       i, fresult[i], sresult[i]);
+          return 1;
+        }
+      }
+      check(LGBM_FleetFree(fleet), "FleetFree");
+      std::printf("window %d: fleet predict matches serve\n", window);
+    }
     check(LGBM_DatasetFree(trainData), "DatasetFree");
   }
   check(LGBM_BoosterSaveModel(booster, 0, -1, "/tmp/lgbm_capi_smoke.model"),

@@ -1,0 +1,359 @@
+"""Multi-tenant model-fleet serving suite (``serve/fleet.py``).
+
+Pins the fleet acceptance contract (docs/Serving.md "Model fleets"):
+per-tenant routing AND scores byte-identical to each tenant's solo
+``PackedEnsemble`` (missing modes, categorical bitsets, file-loaded
+boosters, mixed ``tenant_ids`` batches), tenant hot-swap as a
+zero-retrace device index write while the other tenants keep serving,
+per-replica degrade-to-host byte-exactness, the bf16 value variant's
+routing-exact/values-quantize split, and the host-fallback tenant
+interleave (``ModelMeta.host_raw``'s ``out[i % num_model]``) against
+the packed tree order for multiclass and RF-averaged tenants.
+"""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.boosting import create_boosting
+from lightgbm_tpu.boosting.gbdt import GBDT
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.data.dataset import BinnedDataset
+from lightgbm_tpu.robust import faults
+from lightgbm_tpu.robust.retry import CircuitBreaker
+from lightgbm_tpu.serve import (FleetServer, PredictionServer,
+                                fleet_predict_leaves,
+                                fleet_predict_scores, pack_fleet,
+                                predict_leaves, predict_scores)
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def _train(params, x, y, n_iters=5, categorical=()):
+    cfg = Config({"verbosity": -1, "device_growth": "on",
+                  "num_leaves": 15, "min_data_in_leaf": 5,
+                  "max_depth": 6, **params})
+    ds = BinnedDataset.construct_from_matrix(x, cfg, list(categorical))
+    ds.metadata.set_label(y)
+    bst = create_boosting(cfg)
+    bst.init_train(ds)
+    for _ in range(n_iters):
+        if bst.train_one_iter():
+            break
+    bst._flush_pending()
+    return bst
+
+
+def _binary_booster(seed, nf=8, n_iters=5, nan_frac=0.05, **params):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((1500, nf)).astype(np.float32)
+    if nan_frac:
+        x[rng.random(x.shape) < nan_frac] = np.nan
+    y = (np.nan_to_num(x[:, 0]) + np.abs(np.nan_to_num(x[:, 1]))
+         > 0.4).astype(np.float32)
+    return _train({"objective": "binary", **params}, x, y, n_iters)
+
+
+def _query(seed, nf=8, n=400):
+    rng = np.random.default_rng(seed)
+    xq = rng.standard_normal((n, nf))
+    xq[rng.random(xq.shape) < 0.1] = np.nan
+    return xq
+
+
+@pytest.fixture(scope="module")
+def trio():
+    """Three same-config binary tenants + their solo packs + fleet."""
+    boosters = [_binary_booster(s) for s in (1, 2, 3)]
+    fl, packs = pack_fleet(boosters)
+    return boosters, packs, fl
+
+
+def _assert_tenant_identity(fl, packs, xq):
+    """Leaves AND scores of every tenant byte-identical to its solo
+    pack — the core fleet contract."""
+    for m, pe in enumerate(packs):
+        np.testing.assert_array_equal(
+            fleet_predict_leaves(fl, m, xq)[:, :pe.num_trees],
+            predict_leaves(pe, xq))
+        np.testing.assert_array_equal(
+            fleet_predict_scores(fl, m, xq), predict_scores(pe, xq))
+
+
+def test_fleet_per_tenant_byte_identity(trio):
+    _, packs, fl = trio
+    _assert_tenant_identity(fl, packs, _query(0))
+
+
+def test_fleet_missing_mode_tenant_mix():
+    """A zero_as_missing tenant stacked next to NaN-missing tenants:
+    each keeps its own missing semantics, byte-identical to solo."""
+    rng = np.random.default_rng(11)
+    xz = rng.standard_normal((1500, 8)).astype(np.float32)
+    xz[rng.random(xz.shape) < 0.3] = 0.0
+    yz = (xz[:, 0] + xz[:, 1] > 0.3).astype(np.float32)
+    zb = _train({"objective": "binary", "zero_as_missing": True},
+                xz, yz)
+    boosters = [_binary_booster(1), zb]
+    fl, packs = pack_fleet(boosters)
+    xq = _query(5)
+    xq[rng.random(xq.shape) < 0.2] = 0.0
+    xq[rng.random(xq.shape) < 0.05] = 1e-40   # inside the zero window
+    _assert_tenant_identity(fl, packs, xq)
+
+
+def test_fleet_categorical_tenants():
+    """Tenants with DIFFERENT categorical bitsets (different word
+    counts -> the word-pad path) route byte-identically to solo."""
+    def cat_booster(seed):
+        rng = np.random.default_rng(seed)
+        n = 2000
+        cat = rng.integers(0, 12, n)
+        x = np.column_stack([
+            cat.astype(np.float32),
+            rng.standard_normal(n).astype(np.float32),
+            rng.standard_normal(n).astype(np.float32)])
+        effect = rng.standard_normal(12) * 2.0
+        y = (effect[cat] + x[:, 1]).astype(np.float32)
+        return _train({"objective": "regression", "num_leaves": 31,
+                       "min_data_in_leaf": 40,
+                       "min_gain_to_split": 1e-3},
+                      x, y, n_iters=4, categorical=[0])
+
+    boosters = [cat_booster(13), cat_booster(29)]
+    assert all(any(t.num_cat > 0 for t in b.models) for b in boosters)
+    fl, packs = pack_fleet(boosters)
+    rng = np.random.default_rng(7)
+    xq = np.column_stack([
+        rng.integers(-3, 40, 600).astype(np.float64),   # incl. unseen
+        rng.standard_normal(600), rng.standard_normal(600)])
+    xq[rng.random(600) < 0.1, 0] = np.nan
+    _assert_tenant_identity(fl, packs, xq)
+
+
+def test_fleet_file_loaded_tenant(trio):
+    """A tenant loaded from a model STRING (no train_set) serves
+    byte-identically to its solo pack — raw-value packing end to end."""
+    boosters, _, _ = trio
+    loaded = GBDT.load_model_from_string(boosters[0].model_to_string())
+    assert loaded.train_set is None
+    fl, packs = pack_fleet([loaded, boosters[1]])
+    _assert_tenant_identity(fl, packs, _query(2))
+
+
+def test_fleet_mixed_tenant_batch(trio):
+    """A mixed tenant_ids batch answers every row exactly as that
+    tenant's solo pack/server would — scores AND converted outputs."""
+    boosters, packs, fl = trio
+    xq = _query(3)
+    rng = np.random.default_rng(4)
+    tids = rng.integers(0, len(packs), xq.shape[0]).astype(np.int32)
+    mixed = fleet_predict_scores(fl, tids, xq)
+    fs = FleetServer(boosters)
+    out = fs.predict(tids, xq)
+    for m, pe in enumerate(packs):
+        rows = np.nonzero(tids == m)[0]
+        np.testing.assert_array_equal(mixed[:, rows],
+                                      predict_scores(pe, xq[rows]))
+        np.testing.assert_array_equal(
+            out[rows], PredictionServer(boosters[m]).predict(xq[rows]))
+
+
+def test_fleet_swap_zero_retrace_while_others_serve(trio):
+    """The acceptance gate in miniature: after warmup, retraining one
+    tenant swaps in as a device index write with ZERO new jit compiles
+    while the other tenants keep answering byte-identically."""
+    from lightgbm_tpu import obs
+
+    boosters, packs, _ = trio
+    was_enabled = obs.enabled()
+    obs.configure(enabled=True)
+    try:
+        reg = obs.registry()
+        fs = FleetServer(boosters)
+        xq = _query(6)
+        fs.warmup([xq.shape[0]])
+        fs.predict(0, xq)
+
+        def compiles():
+            return sum(v["compiles"]
+                       for v in reg.snapshot()["jit"].values())
+
+        warm = compiles()
+        swaps0 = reg.counter("serve.fleet.swaps")
+        before2 = predict_scores(packs[2], xq)
+        for seed in (21, 22):
+            assert fs.swap_tenant(1, _binary_booster(seed)) is True
+            np.testing.assert_array_equal(fs.predict(2, xq, True),
+                                          before2[0])
+            fs.predict(1, xq)
+        assert compiles() == warm, reg.snapshot()["jit"]
+        assert reg.counter("serve.fleet.swaps") == swaps0 + 2
+    finally:
+        if not was_enabled:
+            obs.configure(enabled=False)
+
+
+def test_fleet_swap_shape_growth(trio):
+    """A retrained tenant that outgrows the fleet pads re-pads the
+    whole fleet (reported as a shape change) and still serves every
+    tenant byte-identically to solo."""
+    boosters, _, _ = trio
+    fs = FleetServer(boosters)
+    big = _binary_booster(31, n_iters=9)   # 9 iters > the 8-tree pad
+    assert fs.swap_tenant(1, big) is False
+    xq = _query(8)
+    _, packs = pack_fleet([boosters[0], big, boosters[2]])
+    _assert_tenant_identity(fs.fleet, packs, xq)
+
+
+def test_fleet_per_replica_degrade_to_host(trio):
+    """Per-replica degradation: a dead device path on replica 0 trips
+    only replica 0's breaker; its answers come from the host walk
+    BYTE-identical to each tenant's Booster.predict, and replica 1
+    keeps the device path."""
+    boosters, packs, _ = trio
+    fs = FleetServer(
+        boosters, replicas=2,
+        breaker_factory=lambda i: CircuitBreaker(
+            failure_threshold=1, reprobe_interval_s=60.0))
+    xq = _query(9)
+    rng = np.random.default_rng(10)
+    tids = rng.integers(0, len(boosters), xq.shape[0]).astype(np.int32)
+    want_host = np.empty(xq.shape[0], np.float64)
+    for m, b in enumerate(boosters):
+        rows = np.nonzero(tids == m)[0]
+        b.config.device_predict = "off"
+        want_host[rows] = b.predict(xq[rows])
+    faults.configure("serve.fleet.dispatch:persist")
+    try:
+        got = fs.predict(tids, xq, replica=0)
+        np.testing.assert_array_equal(got, want_host)
+        assert fs.degraded_replicas() == [0]
+    finally:
+        faults.clear()
+    # replica 1 never tripped: device path, matches solo device scores
+    dev = fs.predict(tids, xq, raw_score=True, replica=1)
+    for m, pe in enumerate(packs):
+        rows = np.nonzero(tids == m)[0]
+        np.testing.assert_array_equal(dev[rows],
+                                      predict_scores(pe, xq[rows])[0])
+    assert fs.degraded_replicas() == [0]
+    # replica 0 stays dark (re-probe window far out) and stays exact
+    np.testing.assert_array_equal(fs.predict(tids, xq, replica=0),
+                                  want_host)
+
+
+def test_fleet_bf16_values_quantize_routing_exact(trio):
+    """value_dtype=bf16: leaf ROUTING identical to the f32 fleet and
+    to solo packs; accumulated VALUES quantize (close, not equal)."""
+    boosters, packs, _ = trio
+    fs = FleetServer(boosters, value_dtype="bf16")
+    xq = _query(12)
+    for m, pe in enumerate(packs):
+        np.testing.assert_array_equal(
+            fleet_predict_leaves(fs.fleet, m, xq)[:, :pe.num_trees],
+            predict_leaves(pe, xq))
+        sq = fleet_predict_scores(fs.fleet, m, xq)
+        ss = predict_scores(pe, xq)
+        np.testing.assert_allclose(sq, ss, rtol=0.05, atol=0.05)
+        assert not np.array_equal(sq, ss)   # it really quantized
+    assert str(fs.fleet.leaf_value.dtype) == "bfloat16"
+
+
+def test_fleet_multiclass_and_rf_host_interleave():
+    """Regression for the host-fallback tenant interleave
+    (``ModelMeta.host_raw``'s ``out[i % num_model]``) against the
+    packed tree order, with M>1 stacked tenants: multiclass ensembles
+    (num_model=3) and RF averaging must answer BYTE-identically to
+    ``Booster.predict``'s host path when the device is dark."""
+    rng = np.random.default_rng(40)
+
+    def mc_booster(seed):
+        r = np.random.default_rng(seed)
+        x = r.standard_normal((1500, 6)).astype(np.float32)
+        y = np.digitize(x[:, 0] + 0.5 * x[:, 1],
+                        [-0.5, 0.5]).astype(np.float32)
+        return _train({"objective": "multiclass", "num_class": 3},
+                      x, y, 4)
+
+    def rf_booster(seed):
+        r = np.random.default_rng(seed)
+        x = r.standard_normal((1500, 6)).astype(np.float32)
+        y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+        return _train({"objective": "binary", "boosting": "rf",
+                       "bagging_freq": 1, "bagging_fraction": 0.7},
+                      x, y, 4)
+
+    for make in (mc_booster, rf_booster):
+        boosters = [make(41), make(42)]
+        fs = FleetServer(
+            boosters,
+            breaker_factory=lambda i: CircuitBreaker(
+                failure_threshold=1, reprobe_interval_s=60.0))
+        xq = rng.standard_normal((300, 6))
+        tids = rng.integers(0, 2, 300).astype(np.int32)
+        # device answers first (interleave must match the packed order
+        # up to f32 accumulation)
+        dev = fs.predict(tids, xq)
+        faults.configure("serve.fleet.dispatch:persist")
+        try:
+            got = fs.predict(tids, xq)
+        finally:
+            faults.clear()
+        want = np.empty_like(np.asarray(got))
+        for m, b in enumerate(boosters):
+            rows = np.nonzero(tids == m)[0]
+            b.config.device_predict = "off"
+            want[rows] = b.predict(xq[rows])
+        np.testing.assert_array_equal(got, want)
+        np.testing.assert_allclose(dev, want, rtol=1e-4, atol=1e-6)
+
+
+def test_tenant_handle_surface(trio):
+    """TenantHandle: the solo-server surface over one tenant (the
+    pipeline's swap target) — predict/swap/_model route to the fleet."""
+    boosters, packs, _ = trio
+    fs = FleetServer(boosters)
+    h = fs.tenant(2)
+    xq = _query(14)
+    np.testing.assert_array_equal(h.predict(xq), fs.predict(2, xq))
+    assert h._model is fs._snapshot().metas[2]
+    nb = _binary_booster(51)
+    assert h.swap(nb) is True
+    np.testing.assert_array_equal(h.predict(xq),
+                                  PredictionServer(nb).predict(xq))
+    with pytest.raises(LightGBMError, match="out of range"):
+        fs.tenant(3)
+
+
+def test_fleet_submit_round_robin(trio):
+    """submit() coalesces per replica and resolves each Future to
+    exactly what predict() returns for those (tenant_ids, rows)."""
+    boosters, _, _ = trio
+    fs = FleetServer(boosters, replicas=2, max_wait_ms=5.0)
+    rng = np.random.default_rng(15)
+    queries = [(m, rng.standard_normal((n, 8)))
+               for m, n in ((0, 17), (1, 64), (2, 33))]
+    with fs:
+        futs = [fs.submit(m, q) for m, q in queries]
+        got = [f.result(timeout=30) for f in futs]
+    for (m, q), g in zip(queries, got):
+        np.testing.assert_allclose(g, fs.predict(m, q),
+                                   rtol=1e-6, atol=1e-7)
+    with pytest.raises(LightGBMError):
+        fs.submit(0, queries[0][1])   # workers stopped
+
+
+def test_fleet_input_errors(trio):
+    boosters, _, _ = trio
+    fs = FleetServer(boosters)
+    with pytest.raises(LightGBMError, match="tenant_ids"):
+        fs.predict(np.array([0, 1]), np.zeros((3, 8)))   # length mismatch
+    with pytest.raises(LightGBMError, match=r"\[0, 3\)"):
+        fs.predict(7, np.zeros((3, 8)))                  # bad tenant
+    with pytest.raises(LightGBMError, match="features"):
+        fs.predict(0, np.zeros((3, 2)))                  # too narrow
+    assert fs.degraded_replicas() == []                  # no breaker hit
+    with pytest.raises(LightGBMError, match="at least one tenant"):
+        FleetServer([])
+    with pytest.raises(LightGBMError, match="value_dtype"):
+        FleetServer(boosters, value_dtype="fp8")

@@ -333,6 +333,41 @@ def test_serve_capi_roundtrip():
     check(C.LGBM_DatasetFree(ds.value))
 
 
+def test_host_fallback_interleave_multiclass_and_rf():
+    """Regression for the host-fallback tenant interleave
+    (``ModelMeta.host_raw``'s ``out[i % num_model]``): for multiclass
+    ensembles the iteration-major interleave must match the packed
+    tree order, and RF models must apply the per-slice averaging — so
+    degraded answers are BYTE-identical to ``Booster.predict``'s host
+    path."""
+    from lightgbm_tpu.robust import faults
+    from lightgbm_tpu.robust.retry import CircuitBreaker
+
+    rng = np.random.default_rng(23)
+    x = rng.standard_normal((1500, 6)).astype(np.float32)
+    y_mc = np.digitize(x[:, 0] + 0.5 * x[:, 1],
+                       [-0.5, 0.5]).astype(np.float32)
+    y_bin = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    mc = _train({"objective": "multiclass", "num_class": 3}, x, y_mc, 4)
+    rf = _train({"objective": "binary", "boosting": "rf",
+                 "bagging_freq": 1, "bagging_fraction": 0.7},
+                x, y_bin, 4)
+    xq = rng.standard_normal((250, 6))
+    for bst in (mc, rf):
+        srv = PredictionServer(bst, breaker=CircuitBreaker(
+            failure_threshold=1, reprobe_interval_s=60.0))
+        dev = srv.predict(xq)
+        faults.configure("serve.dispatch:persist")
+        try:
+            got = srv.predict(xq)
+        finally:
+            faults.clear()
+        bst.config.device_predict = "off"
+        want = bst.predict(xq)
+        np.testing.assert_array_equal(got, want)
+        np.testing.assert_allclose(dev, want, rtol=1e-4, atol=1e-6)
+
+
 def test_packed_empty_and_stump_models():
     """Degenerate shapes: zero query rows, stump-only models."""
     rng = np.random.default_rng(30)
