@@ -550,6 +550,12 @@ def run_serve(args) -> dict:
     }
     if int(getattr(args, "models", 0)) > 1:
         result["fleet"] = _run_fleet_leg(args, bst, xq, batch)
+    if getattr(args, "slo", ""):
+        # evaluated AFTER every serving leg; the verdict covers the
+        # spec's TRAILING window (default 60 s, ring cap 120 s), not
+        # the whole suite — size window_s to the suite duration if the
+        # early legs must count
+        result["slo"] = _slo_report(args.slo)
     return result
 
 
@@ -617,6 +623,19 @@ def _run_fleet_leg(args, bst, xq, batch) -> dict:
         # backend, meaningful as a pass/fail only on the TPU driver
         "pass_1m_rows_per_s": bool(rows_per_s >= 1.0e6),
     }
+
+
+def _slo_report(spec_text: str) -> dict:
+    """Evaluate a declarative SLO spec (obs/slo.py grammar) against the
+    rolling telemetry the suite just produced and return the full
+    report for the result JSON.  Latency/availability numbers from the
+    CPU container are parity evidence, not chip truth — marked
+    chip-pending exactly like ``pass_1m_rows_per_s``."""
+    import jax
+    from lightgbm_tpu.obs import slo
+    out = slo.evaluate(spec_text).to_json()
+    out["chip_pending"] = jax.default_backend() != "tpu"
+    return out
 
 
 def _cc_counters() -> dict:
@@ -1037,6 +1056,8 @@ def run_cache_admission(args) -> dict:
         result["pipeline_overlap_fraction"] = pipe["overlap_fraction"]
         result["pipeline_speedup_e2e"] = round(
             result["total_s"] / max(pipe["total_s"], 1e-9), 4)
+    if getattr(args, "slo", ""):
+        result["slo"] = _slo_report(args.slo)
     return result
 
 
@@ -1148,6 +1169,15 @@ def main() -> int:
                          "(lightgbm_tpu.pipeline) and report prep-"
                          "overlap fraction + pipelined-vs-serial end-"
                          "to-end speedup next to the headline metric")
+    ap.add_argument("--slo", default=os.environ.get("BENCH_SLO", ""),
+                    help="declarative SLO spec evaluated over the "
+                         "rolling telemetry window after the suite "
+                         "(obs/slo.py grammar, e.g. "
+                         "'availability>=0.999,p95_ms<=50'); the serve "
+                         "and cache suites embed the SloReport in the "
+                         "result JSON (chip-pending on non-TPU "
+                         "backends, like pass_1m_rows_per_s) and the "
+                         "obs digest carries its compact form")
     ap.add_argument("--metrics", default=os.environ.get("BENCH_METRICS",
                                                         ""),
                     help="write the telemetry metrics JSON snapshot "
@@ -1179,7 +1209,7 @@ def main() -> int:
     # telemetry: on by default so every BENCH_*.json round captures
     # recompile counts and p95 iteration time alongside the phase means
     from lightgbm_tpu import obs
-    if not args.no_obs or args.metrics or args.trace:
+    if not args.no_obs or args.metrics or args.trace or args.slo:
         obs.configure(enabled=True, sync=args.profile)
     else:
         # genuinely disable (env vars may have enabled it at import)

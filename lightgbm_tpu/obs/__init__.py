@@ -7,12 +7,16 @@ to a single flag check when disabled, so the hot path pays nothing.
 
 Enable it three ways (any one suffices):
 
-* config params: ``metrics_enabled=true`` and/or ``trace_path=out.json``
+* config params: ``metrics_enabled=true`` and/or any output path —
+  ``metrics_path`` / ``trace_path`` / ``events_path`` / the streaming
+  exporter's ``stream_path`` / ``prom_path`` / ``obs_http_port``
   (picked up by ``GBDT.init_train``, so ``engine.train``, the sklearn
   wrapper, the C API and the embedded windowed harness all inherit it);
 * env vars: ``LGBM_TPU_METRICS=<path|1>`` / ``LGBM_TPU_TRACE=<path>``
-  / ``LGBM_TPU_EVENTS=<path.jsonl>`` — files are written at process
-  exit, which is how the ``src/capi`` harness gets per-window retrain
+  / ``LGBM_TPU_EVENTS=<path.jsonl>`` / ``LGBM_TPU_STREAM`` /
+  ``LGBM_TPU_PROM`` / ``LGBM_TPU_OBS_HTTP`` — snapshot files are
+  written at process exit (the stream/exposition files refresh live),
+  which is how the ``src/capi`` harness gets per-window retrain
   telemetry without a code change;
 * programmatically: ``obs.configure(enabled=True, ...)`` (what
   ``bench.py --metrics/--trace`` does).
@@ -32,13 +36,15 @@ from typing import Dict, Optional, Tuple
 
 from .jit_track import track_jit  # noqa: F401  (re-export)
 from .registry import MetricsRegistry  # noqa: F401  (re-export)
+from .rolling import RollingRegistry
 from .state import STATE
 
 SCHEMA_NAME = "lightgbm-tpu-metrics"
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 __all__ = [
     "enabled", "configure", "configure_from_config", "reset", "registry",
+    "rolling", "rolling_snapshot",
     "inc", "set_gauge", "max_gauge", "observe", "span", "instant",
     "counter_sample", "track_jit", "sample_device_memory",
     "device_memory_stats", "snapshot", "summary", "dump_metrics",
@@ -58,16 +64,36 @@ def registry() -> MetricsRegistry:
     return STATE.registry
 
 
+def rolling() -> Optional[RollingRegistry]:
+    """The rolling-window mirror (None while telemetry is disabled)."""
+    return STATE.rolling
+
+
 def configure(enabled: Optional[bool] = None,
               metrics_path: Optional[str] = None,
               trace_path: Optional[str] = None,
               events_path: Optional[str] = None,
-              sync: Optional[bool] = None) -> None:
+              sync: Optional[bool] = None,
+              rolling=None,
+              stream_path: Optional[str] = None,
+              prom_path: Optional[str] = None,
+              export_interval_s: Optional[float] = None,
+              http_port: Optional[int] = None,
+              slo_spec=None) -> None:
     """Update the global observability state.
 
     Additive: ``None`` leaves a setting untouched, and enabling twice
     keeps the accumulated registry/trace (windowed retraining wants
     cross-window totals).  Use :func:`reset` for a clean slate.
+
+    Enabling also installs the rolling-window mirror (``rolling=False``
+    opts out; a :class:`~.rolling.RollingRegistry` instance replaces
+    it).  ``stream_path`` (JSONL time series) / ``prom_path``
+    (Prometheus exposition file) / ``http_port`` (localhost scrape
+    endpoint; 0 picks a free port) start the background
+    :class:`~.export.StreamExporter`, flushing every
+    ``export_interval_s`` seconds (default 5); ``slo_spec`` makes each
+    flush carry a fresh SLO evaluation (docs/Observability.md).
     """
     if metrics_path:
         STATE.metrics_path = metrics_path
@@ -84,13 +110,72 @@ def configure(enabled: Optional[bool] = None,
             _install_timer_sink()
         elif was and not STATE.enabled:
             _remove_timer_sink()
+    if rolling is False:
+        # sticky: the per-window configure_from_config calls pass
+        # rolling=None and must not silently undo an explicit opt-out
+        STATE.rolling = None
+        STATE.rolling_opt_out = True
+    elif isinstance(rolling, RollingRegistry):
+        STATE.rolling = rolling
+        STATE.rolling_opt_out = False
+    elif rolling is True:
+        STATE.rolling_opt_out = False
+    if (STATE.enabled and STATE.rolling is None
+            and not STATE.rolling_opt_out):
+        STATE.rolling = RollingRegistry()
+    if slo_spec is not None:
+        # parse HERE so a typo'd spec raises at configure time even
+        # when no exporter exists yet; an exporter started later (or
+        # already running) adopts it
+        from .slo import SloSpec
+        if isinstance(slo_spec, str):
+            slo_spec = SloSpec.parse(slo_spec)
+        STATE.pending_slo_spec = slo_spec
+        if STATE.exporter is not None and not (
+                stream_path or prom_path or http_port is not None):
+            STATE.exporter.set_slo_spec(slo_spec)
+    if stream_path or prom_path or http_port is not None:
+        _ensure_exporter(stream_path, prom_path, export_interval_s,
+                         http_port, slo_spec)
     if STATE.enabled and (STATE.metrics_path or STATE.trace_path
-                          or STATE.events_path):
+                          or STATE.events_path
+                          or STATE.exporter is not None):
         _register_atexit()
 
 
+def _ensure_exporter(stream_path, prom_path, export_interval_s,
+                     http_port, slo_spec) -> None:
+    """Start (or retarget) the background exporter.  Idempotent for the
+    per-window ``configure_from_config`` call: matching paths only
+    update interval/spec, they never restart the threads.  ADDITIVE
+    like the rest of configure(): an unspecified target inherits the
+    running exporter's (env-started stream + param-added prom file
+    coexist), so a partial reconfigure never silently drops an
+    export."""
+    from .export import StreamExporter
+    if slo_spec is None:
+        slo_spec = STATE.pending_slo_spec
+    exp = STATE.exporter
+    if exp is not None:
+        stream_path = stream_path or exp.stream_path
+        prom_path = prom_path or exp.prom_path
+        if http_port is None:
+            http_port = exp._http_port_requested
+        if exp.matches(stream_path, prom_path, http_port):
+            if export_interval_s:
+                exp.interval_s = max(float(export_interval_s), 0.05)
+            if slo_spec is not None:
+                exp.set_slo_spec(slo_spec)
+            return
+        exp.stop()
+    STATE.exporter = StreamExporter(
+        stream_path=stream_path, prom_path=prom_path,
+        interval_s=export_interval_s or 5.0,
+        http_port=http_port, slo_spec=slo_spec).start()
+
+
 def configure_from_config(cfg) -> None:
-    """Pick up ``metrics_enabled`` / ``trace_path`` from a Config.
+    """Pick up ``metrics_enabled`` / the telemetry paths from a Config.
 
     Called on every ``GBDT.init_train`` — i.e. once per booster, which
     in the windowed harness means once per retrain window — so it must
@@ -100,16 +185,30 @@ def configure_from_config(cfg) -> None:
     want = bool(getattr(cfg, "metrics_enabled", False))
     trace_path = str(getattr(cfg, "trace_path", "") or "")
     metrics_path = str(getattr(cfg, "metrics_path", "") or "")
-    if not (want or trace_path or metrics_path):
+    events_path = str(getattr(cfg, "events_path", "") or "")
+    stream_path = str(getattr(cfg, "stream_path", "") or "")
+    prom_path = str(getattr(cfg, "prom_path", "") or "")
+    http_port = int(getattr(cfg, "obs_http_port", 0) or 0)
+    if not (want or trace_path or metrics_path or events_path
+            or stream_path or prom_path or http_port):
         return
     configure(enabled=True, metrics_path=metrics_path or None,
-              trace_path=trace_path or None)
+              trace_path=trace_path or None,
+              events_path=events_path or None,
+              stream_path=stream_path or None,
+              prom_path=prom_path or None,
+              export_interval_s=float(getattr(
+                  cfg, "obs_export_interval", 0) or 0) or None,
+              http_port=http_port if http_port > 0 else None)
 
 
 def reset() -> None:
     """Clear all accumulated metrics and events (keeps enabled/paths)."""
     STATE.registry.reset()
     STATE.trace.reset()
+    if STATE.rolling is not None:
+        STATE.rolling.reset()
+    STATE.last_slo = None
     STATE._mem_unavailable = False
     STATE._trace_flushed = None
 
@@ -121,11 +220,17 @@ def reset() -> None:
 def inc(name: str, value: int = 1) -> None:
     if STATE.enabled:
         STATE.registry.inc(name, value)
+        r = STATE.rolling
+        if r is not None:
+            r.inc(name, value)
 
 
 def set_gauge(name: str, value: float) -> None:
     if STATE.enabled:
         STATE.registry.set_gauge(name, value)
+        r = STATE.rolling
+        if r is not None:
+            r.set_gauge(name, value)
 
 
 def max_gauge(name: str, value: float) -> None:
@@ -136,6 +241,9 @@ def max_gauge(name: str, value: float) -> None:
 def observe(name: str, seconds: float) -> None:
     if STATE.enabled:
         STATE.registry.observe(name, seconds)
+        r = STATE.rolling
+        if r is not None:
+            r.observe(name, seconds)
 
 
 class _NullSpan:
@@ -191,6 +299,9 @@ class _Span:
             jax.block_until_ready(self.sync_value)
         dur = time.perf_counter() - self.t0
         STATE.registry.observe(self.name, dur)
+        r = STATE.rolling
+        if r is not None:
+            r.observe(self.name, dur)
         STATE.trace.add(self.name, cat=self.cat, t0=self.t0, dur=dur,
                         args=self.args or None)
         return False
@@ -278,7 +389,18 @@ def snapshot() -> Dict:
         if mem else None)
     doc["events"] = {"recorded": len(STATE.trace),
                      "dropped": STATE.trace.dropped}
+    doc["rolling"] = (STATE.rolling.window()
+                      if STATE.rolling is not None else None)
+    doc["slo"] = (STATE.last_slo.digest()
+                  if STATE.last_slo is not None else None)
     return doc
+
+
+def rolling_snapshot(window_s: Optional[float] = None) -> Optional[Dict]:
+    """The rolling-window document alone (None while disabled)."""
+    if STATE.rolling is None:
+        return None
+    return STATE.rolling.window(window_s)
 
 
 def summary() -> Dict:
@@ -367,6 +489,12 @@ def summary() -> Dict:
             "checkpoints": snap["counters"].get(
                 "pipeline.checkpoints", 0),
         }
+    if STATE.last_slo is not None:
+        out["slo"] = STATE.last_slo.digest()
+    exp = STATE.exporter
+    if exp is not None:
+        out["export"] = {"flushes": exp.flushes, "dropped": exp.dropped,
+                         "write_errors": exp.write_errors}
     windows = snap["counters"].get("pipeline.windows", 0)
     if windows:
         prep = snap["timings"].get("pipeline.prep")
@@ -424,13 +552,28 @@ def flush() -> None:
     dump_metrics()
     dump_trace()
     dump_events_jsonl()
+    if STATE.exporter is not None:
+        STATE.exporter.flush_now()
+
+
+def _atexit_flush() -> None:
+    # stop() already performs a final synchronous exporter flush, so
+    # only the snapshot files are written here (no duplicated final
+    # stream line)
+    exp = STATE.exporter
+    if exp is not None:
+        exp.stop()
+    if STATE.enabled:
+        dump_metrics()
+        dump_trace()
+        dump_events_jsonl()
 
 
 def _register_atexit() -> None:
     if STATE._atexit_registered:
         return
     import atexit
-    atexit.register(flush)
+    atexit.register(_atexit_flush)
     STATE._atexit_registered = True
 
 
@@ -440,6 +583,9 @@ def _register_atexit() -> None:
 
 def _timer_sink(tag: str, seconds: float) -> None:
     STATE.registry.observe(f"phase.{tag}", seconds)
+    r = STATE.rolling
+    if r is not None:
+        r.observe(f"phase.{tag}", seconds)
 
 
 def _install_timer_sink() -> None:
@@ -482,6 +628,9 @@ def iteration_hooks() -> Tuple:
             return
         dur = time.perf_counter() - t0
         STATE.registry.observe("engine.iter", dur)
+        r = STATE.rolling
+        if r is not None:
+            r.observe("engine.iter", dur)
         STATE.trace.add("engine_iter", cat="engine", t0=t0, dur=dur,
                         args={"iteration": env.iteration})
         for rec in (env.evaluation_result_list or []):
@@ -502,9 +651,15 @@ def _configure_from_env() -> None:
     metrics = os.environ.get("LGBM_TPU_METRICS", "")
     trace = os.environ.get("LGBM_TPU_TRACE", "")
     events = os.environ.get("LGBM_TPU_EVENTS", "")
+    stream = os.environ.get("LGBM_TPU_STREAM", "")
+    prom = os.environ.get("LGBM_TPU_PROM", "")
+    try:
+        http_port = int(os.environ.get("LGBM_TPU_OBS_HTTP", "") or 0)
+    except ValueError:
+        http_port = 0
     if metrics.lower() in ("0", "false", "no"):
         metrics = ""
-    if not (metrics or trace or events):
+    if not (metrics or trace or events or stream or prom or http_port):
         return
     configure(
         enabled=True,
@@ -512,6 +667,9 @@ def _configure_from_env() -> None:
         else None,
         trace_path=trace or None,
         events_path=events or None,
+        stream_path=stream or None,
+        prom_path=prom or None,
+        http_port=http_port if http_port > 0 else None,
         sync=os.environ.get("LGBM_TPU_OBS_SYNC", "") in ("1", "true"),
     )
 

@@ -17,7 +17,9 @@ from .registry import MetricsRegistry
 
 
 class ObsState:
-    __slots__ = ("enabled", "sync", "registry", "trace",
+    __slots__ = ("enabled", "sync", "registry", "trace", "rolling",
+                 "rolling_opt_out", "exporter", "last_slo",
+                 "pending_slo_spec",
                  "metrics_path", "trace_path", "events_path",
                  "_atexit_registered", "_mem_unavailable",
                  "_trace_flushed")
@@ -30,6 +32,22 @@ class ObsState:
         self.sync = False
         self.registry = MetricsRegistry()
         self.trace = TraceBuffer()
+        # rolling-window mirror of the registry (obs/rolling.py) —
+        # created when telemetry is enabled, None while disabled so the
+        # hot path stays a single flag check; rolling_opt_out persists
+        # an explicit configure(rolling=False) across the per-window
+        # configure_from_config calls
+        self.rolling = None
+        self.rolling_opt_out = False
+        # background StreamExporter (obs/export.py), None until a
+        # stream/prom path or scrape port is configured
+        self.exporter = None
+        # most recent SloReport (obs/slo.py), embedded in summary() and
+        # stream lines
+        self.last_slo = None
+        # a parsed SloSpec configured before any exporter exists —
+        # adopted by the next exporter start instead of being dropped
+        self.pending_slo_spec = None
         self.metrics_path: Optional[str] = None
         self.trace_path: Optional[str] = None
         self.events_path: Optional[str] = None

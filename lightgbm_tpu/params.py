@@ -335,6 +335,39 @@ PARAM_SCHEMA: Sequence[Param] = (
     _p("metrics_path", str, "", ("metrics_file",),
        desc="write the telemetry metrics JSON snapshot to this path at the "
             "end of train() (implies metrics_enabled)", section="io"),
+    _p("events_path", str, "", ("events_file",),
+       desc="write the trace-event buffer as JSONL (one event per line: "
+            "t_unix, name, cat, kind, dur_s, args) to this path at process "
+            "exit (implies metrics_enabled). The streaming counterpart of "
+            "trace_path for jq/pandas post-processing; the per-window "
+            "feature-gain events land here. Env override: "
+            "LGBM_TPU_EVENTS=<path.jsonl>. See docs/Observability.md",
+       section="io"),
+    _p("stream_path", str, "", ("stream_file",),
+       desc="append a rolling-window telemetry snapshot line (JSONL time "
+            "series: counter rates, gauge means, p50/p95/p99 over the "
+            "last window, latest SLO digest) every obs_export_interval "
+            "seconds via the background exporter (implies "
+            "metrics_enabled; docs/Observability.md \"Streaming & "
+            "SLOs\"). Export is bounded-queue + drop-counter: it can "
+            "never stall training or serving. Env override: "
+            "LGBM_TPU_STREAM=<path.jsonl>", section="io"),
+    _p("prom_path", str, "", ("prometheus_path",),
+       desc="atomically rewrite a Prometheus text-exposition file at this "
+            "path every obs_export_interval seconds (implies "
+            "metrics_enabled): counters as _total, gauges, timings as "
+            "summaries with rolling-window quantiles. Env override: "
+            "LGBM_TPU_PROM=<path>", section="io"),
+    _p("obs_export_interval", float, 5.0, (), check="> 0.0",
+       desc="seconds between background telemetry exporter flushes "
+            "(stream_path / prom_path / the scrape endpoint)",
+       section="io"),
+    _p("obs_http_port", int, 0, (), check=">= 0",
+       desc="opt-in localhost Prometheus scrape endpoint: serve the "
+            "text exposition at http://127.0.0.1:<port>/metrics "
+            "(implies metrics_enabled). 0 disables (default — the "
+            "library never binds a socket unasked). Env override: "
+            "LGBM_TPU_OBS_HTTP=<port>", section="io"),
     _p("pipeline_windows", int, 4, (), check="> 0",
        desc="task=pipeline (CLI): number of equal row windows the "
             "training file is replayed as through the windowed-retrain "

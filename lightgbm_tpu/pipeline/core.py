@@ -488,6 +488,34 @@ class RetrainPipeline:
         self._prev = bst
         return bst
 
+    def _emit_feature_telemetry(self, bst, idx: int, policy: str) -> None:
+        """Per-window split-gain/importance event (ROADMAP item 4's
+        observability half): the trained window's top feature gains
+        stream as one instant event next to the ``pipeline.drift``
+        bin-occupancy gauge, so feature drift across retrain windows is
+        observable and explainable from the same dashboard."""
+        if not obs.enabled():
+            return
+        try:
+            gain = np.asarray(bst.feature_importance("gain"), np.float64)
+            splits = np.asarray(bst.feature_importance("split"),
+                                np.float64)
+        except Exception:   # noqa: BLE001 — non-gbdt boosters
+            return
+        total = float(gain.sum())
+        order = np.argsort(gain)[::-1][:16]
+        top = [[int(f), round(float(gain[f]), 5), int(splits[f])]
+               for f in order if gain[f] > 0.0]
+        obs.instant("pipeline.window_features", cat="pipeline",
+                    window=idx, policy=policy, features=int(gain.size),
+                    total_gain=round(total, 5), top=top)
+        obs.inc("pipeline.feature_events")
+        if total > 0.0 and top:
+            # share of total gain held by the strongest feature: a
+            # cheap scalar drift indicator next to pipeline.drift
+            obs.set_gauge("pipeline.gain_top_share",
+                          round(top[0][1] / total, 5))
+
     # -- serving ------------------------------------------------------
     def _swap(self, bst) -> Tuple[float, Optional[bool]]:
         if self.server is None:
@@ -497,6 +525,9 @@ class RetrainPipeline:
         same = self.server.swap(bst)
         swap_s = time.perf_counter() - t0
         obs.observe("pipeline.swap", swap_s)
+        # model-freshness anchor for the SLO engine (obs/slo.py
+        # ``freshness_s<=D``): age of the served model = now minus this
+        obs.set_gauge("pipeline.last_swap_unix", time.time())
         # a fleet TenantHandle always has a model (the fleet seeds every
         # tenant), so warm on the first swap of THIS pipeline, not only
         # when the server was empty
@@ -595,6 +626,7 @@ class RetrainPipeline:
                                   window=idx, policy=policy):
                         bst = self._train_window(ds, policy)
                     t1 = time.perf_counter()
+                    self._emit_feature_telemetry(bst, idx, policy)
                     swap_s, same = self._swap(bst)
                     if self.checkpoint_dir:
                         # commit the completed window AFTER serving has

@@ -145,11 +145,23 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at: Optional[float] = None    # degraded duration
         self._next_probe_at = 0.0                  # probe scheduling
+        self._dark_total = 0.0                     # closed dark periods
 
     @property
     def state(self) -> str:
         with self._lock:
             return "open" if self._opened_at is not None else "closed"
+
+    def dark_seconds(self) -> float:
+        """Total seconds this breaker has spent open, INCLUDING the
+        current still-open period.  ``record_success`` reports a dark
+        period only at recovery; live availability accounting (the SLO
+        engine's window evaluation, obs/slo.py) cannot wait for one."""
+        with self._lock:
+            total = self._dark_total
+            if self._opened_at is not None:
+                total += self._clock() - self._opened_at
+            return total
 
     def allow(self) -> bool:
         """May the caller attempt the guarded operation right now?
@@ -176,6 +188,7 @@ class CircuitBreaker:
             if self._opened_at is None:
                 return None
             dark = self._clock() - self._opened_at
+            self._dark_total += dark
             self._opened_at = None
             return dark
 

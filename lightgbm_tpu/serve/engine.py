@@ -205,6 +205,13 @@ class PredictionServer:
         requests answered by the host fallback)."""
         return self._breaker.state == "open"
 
+    @property
+    def dark_seconds(self) -> float:
+        """Total breaker-open seconds, including a still-open period —
+        the live availability denominator the SLO engine charges
+        against (``serve.degraded_time`` only lands at recovery)."""
+        return self._breaker.dark_seconds()
+
     # -- model lifecycle ------------------------------------------------
     def swap(self, booster) -> bool:
         """Atomically replace the served model.  Packing and device
@@ -292,7 +299,10 @@ class PredictionServer:
         if data.shape[1] < model.packed.num_features:
             # an input fault, not a device fault — fail the REQUEST
             # without involving breaker or fallback (the host walk would
-            # read out-of-range feature indices)
+            # read out-of-range feature indices).  Distinguished in
+            # telemetry: input errors never count against availability
+            # (obs/slo.py)
+            obs.inc("serve.input_errors")
             raise LightGBMError(
                 f"query data has {data.shape[1]} features but the "
                 f"served model needs {model.packed.num_features}")
@@ -308,11 +318,20 @@ class PredictionServer:
                 dark = self._breaker.record_success()
                 if dark is not None:
                     obs.observe("serve.degraded_time", dark)
-                    obs.set_gauge("serve.degraded", 0)
                     log_warning(f"serve: device path recovered after "
                                 f"{dark:.3f} s degraded")
+                # written on EVERY success, not just recovery: the
+                # rolling gauge timeline integrates from its first
+                # transition, so the healthy prefix must be on record
+                # or a trip late in a window reads as a full-window
+                # outage (a same-value re-set is a no-op in the ring)
+                obs.set_gauge("serve.degraded", 0)
+                obs.inc("serve.ok")
                 return raw
         if not self.host_fallback or model.host_trees is None:
+            # the request goes UNANSWERED: the availability SLO's hard
+            # failure bucket
+            obs.inc("serve.failed")
             if err is not None:
                 raise err
             raise LightGBMError(

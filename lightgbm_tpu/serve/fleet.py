@@ -466,6 +466,10 @@ class FleetServer:
         self._gen = _FleetGen(self._replicate(fleet), metas)
         obs.set_gauge("serve.fleet.tenants", fleet.num_tenants)
         obs.set_gauge("serve.fleet.replicas", n_rep)
+        # anchor the rolling timeline at 0 dark replicas: without it a
+        # first degradation mid-window would integrate as a full-window
+        # outage in the SLO's dark fraction (obs/slo.py)
+        obs.set_gauge("serve.fleet.degraded_replicas", 0)
 
     # -- construction helpers -------------------------------------------
     def _meta_for(self, gbdt, pe: PackedEnsemble) -> ModelMeta:
@@ -653,6 +657,9 @@ class FleetServer:
         faults."""
         fl = gen.fleets[rep.index]
         if data.shape[1] < fl.num_features:
+            # input fault: fails the request, never the availability
+            # SLO (obs/slo.py) nor the breaker
+            obs.inc("serve.fleet.input_errors")
             raise LightGBMError(
                 f"query data has {data.shape[1]} features but the "
                 f"fleet needs {fl.num_features}")
@@ -672,8 +679,10 @@ class FleetServer:
                     log_warning(
                         f"fleet replica {rep.index}: device path "
                         f"recovered after {dark:.3f} s degraded")
+                obs.inc("serve.fleet.ok")
                 return raw
         if not self.host_fallback:
+            obs.inc("serve.fleet.failed")
             if err is not None:
                 raise err
             raise LightGBMError(
@@ -729,11 +738,13 @@ class FleetServer:
             tid = np.full(n, int(tid), np.int32)
         # input faults, not device faults: fail the REQUEST before any
         # dispatch so neither the breaker nor the host fallback sees a
-        # malformed batch
+        # malformed batch (counted apart from availability, obs/slo.py)
         if tid.shape != (n,):
+            obs.inc("serve.fleet.input_errors")
             raise LightGBMError(
                 f"tenant_ids shape {tid.shape} does not match {n} rows")
         if n and (tid.min() < 0 or tid.max() >= gen.fleet.num_tenants):
+            obs.inc("serve.fleet.input_errors")
             raise LightGBMError(
                 f"tenant_ids must be in [0, {gen.fleet.num_tenants}); "
                 f"got [{tid.min()}, {tid.max()}]")

@@ -67,7 +67,8 @@ fi
 step "jaxlint (zero-debt modules)" python -m lightgbm_tpu.tools.jaxlint \
     lightgbm_tpu/ops/stage_plan.py lightgbm_tpu/ops/hist_pallas.py \
     lightgbm_tpu/ops/shard.py lightgbm_tpu/parallel lightgbm_tpu/serve \
-    lightgbm_tpu/pipeline lightgbm_tpu/robust --no-baseline
+    lightgbm_tpu/pipeline lightgbm_tpu/robust lightgbm_tpu/obs \
+    --no-baseline
 
 # 3. the telemetry schema validator validates itself
 step "validate_metrics --self-test" \
@@ -96,6 +97,14 @@ if [[ "${1:-}" != "--fast" ]]; then
     #      retrain, every probe byte-identical to the untouched
     #      tenants' solo servers (docs/Serving.md "Model fleets")
     step "fleet smoke" python scripts/check_fleet.py
+
+    # 5b3. streaming-telemetry smoke: a healthy serve run must PASS its
+    #      SLO spec and the same run under an LGBM_TPU_FAULTS persistent
+    #      serve device-death injection must FAIL availability (the
+    #      gate can fire); JSONL stream + Prometheus exposition
+    #      validate; the disabled hot path stays a single flag check
+    #      (docs/Observability.md "Streaming & SLOs")
+    step "obs smoke" python scripts/check_obs.py
 
     # 5c. chaos smoke: a mid-stream kill (injected prep fault) resumes
     #     from the per-window checkpoint to a byte-identical final

@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""CI streaming-telemetry smoke (docs/Observability.md "Streaming &
+SLOs").
+
+Gates four contracts of the streaming layer, chaos-coupled so the SLO
+gate is proven able to FIRE, not just to pass:
+
+1. **Healthy run passes** — a trained model served normally meets an
+   ``availability>=0.999`` + generous p95 spec evaluated from the
+   rolling window.
+2. **Injected device death fails availability** — the SAME serve loop
+   under ``LGBM_TPU_FAULTS=serve.dispatch:persist`` answers every
+   request through the host fallback, but the breaker's dark time
+   counts against availability, so the spec must FAIL on exactly the
+   availability objective (and only because of dark time: every
+   request is still answered).
+3. **Exports validate** — the JSONL stream lines, the Prometheus
+   exposition file (metric-name legality, no duplicate samples) and
+   the full metrics snapshot all pass ``scripts/validate_metrics.py``.
+4. **Disabled hot path stays a flag check** — with telemetry off,
+   spans are the shared no-op singleton, nothing lands in the registry
+   or the rolling window, and serving answers normally.
+
+Exit 0 on success, 1 with diagnostics on failure.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "validate_metrics", os.path.join(REPO, "scripts",
+                                     "validate_metrics.py"))
+validate_metrics = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(validate_metrics)
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+          "min_data_in_leaf": 5, "verbosity": -1, "metric": "none",
+          "num_iterations": 6, "device_growth": "on"}
+FEATURES = 8
+SPEC = "availability>=0.999,p95_ms<=60000,window_s=60"
+
+
+def train_model():
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data.dataset import BinnedDataset
+
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((3000, FEATURES))
+    y = (x[:, 0] > 0).astype(np.float64)
+    cfg = Config(dict(PARAMS))
+    ds = BinnedDataset.construct_from_matrix(x, cfg)
+    ds.metadata.set_label(y)
+    bst = create_boosting(cfg)
+    bst.init_train(ds)
+    bst.train_chunked(PARAMS["num_iterations"], chunk=3)
+    bst._flush_pending()
+    return bst, x
+
+
+def serve_loop(bst, x, requests=30):
+    from lightgbm_tpu.robust import CircuitBreaker
+    from lightgbm_tpu.serve.engine import PredictionServer
+
+    srv = PredictionServer(bst, breaker=CircuitBreaker(
+        failure_threshold=2, reprobe_interval_s=30.0))
+    srv.warmup([256])
+    q = x[:256]
+    for _ in range(requests):
+        srv.predict(q)
+    return srv
+
+
+def gate_healthy(failures, bst, x):
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.obs import slo
+
+    obs.reset()
+    serve_loop(bst, x)
+    rep = slo.evaluate(SPEC)
+    if not rep.ok:
+        failures.append(f"healthy run FAILED its SLO spec: "
+                        f"{json.dumps(rep.to_json())}")
+    return rep.to_json()
+
+
+def gate_injected_death(failures, bst, x):
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.obs import slo
+    from lightgbm_tpu.robust import faults
+
+    obs.reset()
+    os.environ["LGBM_TPU_FAULTS"] = "serve.dispatch:persist"
+    try:
+        faults.configure_from_env()
+        srv = serve_loop(bst, x)
+        rep = slo.evaluate(SPEC)
+    finally:
+        faults.clear()
+        os.environ.pop("LGBM_TPU_FAULTS", None)
+    if srv.dark_seconds <= 0:
+        failures.append("breaker reports no live dark time while open "
+                        "(CircuitBreaker.dark_seconds)")
+    avail = rep.objective("availability")
+    if rep.ok or avail is None or avail.ok:
+        failures.append(
+            f"injected device death did NOT fail the availability "
+            f"SLO — the gate cannot fire: {json.dumps(rep.to_json())}")
+    if rep.counts.get("failed", 0):
+        failures.append(
+            f"injected device death DROPPED "
+            f"{rep.counts['failed']} requests (fallback contract "
+            f"broken; availability should fail on dark time alone)")
+    if rep.counts.get("dark_fraction", 0) <= 0:
+        failures.append("breaker dark time did not register in the "
+                        "rolling window")
+    return rep.to_json()
+
+
+def gate_exports(failures, bst, x):
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.obs.state import STATE
+
+    obs.reset()
+    d = tempfile.mkdtemp(prefix="lgbm_obs_smoke_")
+    stream = os.path.join(d, "stream.jsonl")
+    prom = os.path.join(d, "metrics.prom")
+    metrics = os.path.join(d, "metrics.json")
+    obs.configure(stream_path=stream, prom_path=prom,
+                  export_interval_s=0.5, slo_spec=SPEC)
+    try:
+        serve_loop(bst, x)
+        obs.flush()
+    finally:
+        exp = STATE.exporter
+        STATE.exporter = None
+        if exp is not None:
+            exp.stop()
+    obs.dump_metrics(metrics)
+
+    n_lines = 0
+    for i, line in enumerate(open(stream), 1):
+        n_lines += 1
+        errs = validate_metrics.validate_stream_line(json.loads(line))
+        for e in errs:
+            failures.append(f"stream line {i}: {e}")
+    if not n_lines:
+        failures.append("exporter wrote no stream lines")
+    prom_text = open(prom).read()
+    for e in validate_metrics.validate_prometheus(prom_text):
+        failures.append(f"prometheus exposition: {e}")
+    doc = json.load(open(metrics))
+    for e in validate_metrics.validate(doc):
+        failures.append(f"metrics snapshot: {e}")
+    if doc.get("rolling") is None:
+        failures.append("metrics snapshot has no rolling block")
+    slo_line = any("slo" in json.loads(ln) for ln in open(stream))
+    if not slo_line:
+        failures.append("no stream line carried the SLO digest")
+    return {"stream_lines": n_lines,
+            "prom_samples": sum(1 for ln in prom_text.splitlines()
+                                if ln and not ln.startswith("#")),
+            "dir": d}
+
+
+def gate_disabled_hot_path(failures, bst, x):
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.obs.state import STATE
+
+    obs.configure(enabled=False)
+    obs.reset()
+    # the disabled fast path must be the shared singletons: one flag
+    # check, zero allocation, nothing recorded anywhere
+    if obs.span("grow_tree") is not obs.span("serve.predict"):
+        failures.append("disabled span is not the shared no-op "
+                        "singleton (hot path allocates)")
+    obs.inc("serve.ok")
+    obs.observe("serve.predict", 1.0)
+    obs.set_gauge("serve.degraded", 1)
+    serve_loop(bst, x, requests=3)
+    snap = STATE.registry.snapshot()
+    recorded = (snap["counters"] or snap["gauges"] or snap["timings"])
+    if recorded:
+        failures.append(f"disabled telemetry still recorded: {recorded}")
+    if STATE.rolling is not None and \
+            STATE.rolling.window()["counters"]:
+        failures.append("disabled telemetry still fed the rolling "
+                        "window")
+    return {"recorded": bool(recorded)}
+
+
+def main() -> int:
+    from lightgbm_tpu import obs
+
+    failures = []
+    bst, x = train_model()
+    obs.configure(enabled=True)
+    summary = {
+        "healthy": gate_healthy(failures, bst, x),
+        "injected_death": gate_injected_death(failures, bst, x),
+        "exports": gate_exports(failures, bst, x),
+        "disabled": gate_disabled_hot_path(failures, bst, x),
+    }
+    print(json.dumps(summary))
+    if failures:
+        for f in failures:
+            print(f"OBS SMOKE FAIL: {f}", file=sys.stderr)
+        return 1
+    hd = summary["healthy"]["counts"]
+    dd = summary["injected_death"]["counts"]
+    print(f"obs smoke PASS: healthy SLO ok "
+          f"({hd['ok']} device-ok requests), injected death failed "
+          f"availability (dark_fraction={dd['dark_fraction']}, "
+          f"{dd['fallback']} fallbacks, 0 dropped), "
+          f"{summary['exports']['stream_lines']} stream lines + "
+          f"{summary['exports']['prom_samples']} exposition samples "
+          f"validated, disabled path records nothing")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

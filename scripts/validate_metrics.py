@@ -1,12 +1,18 @@
 #!/usr/bin/env python
-"""Schema-check a telemetry metrics JSON (docs/Observability.md).
+"""Schema-check telemetry artifacts (docs/Observability.md).
 
-Usage: ``python scripts/validate_metrics.py metrics.json``
+Usage::
+
+    python scripts/validate_metrics.py metrics.json     # snapshot doc
+    python scripts/validate_metrics.py --stream s.jsonl # exporter stream
+    python scripts/validate_metrics.py --prom m.prom    # exposition file
+
 Exit 0 when the document is schema-valid, 1 with one error per line
-otherwise.  Also importable: ``validate(doc) -> list[str]`` (empty ==
-valid).  ``tests/test_obs.py`` runs this against a live 2-iteration
-``bench.py --metrics`` run so tier-1 exercises the enabled path end to
-end.
+otherwise.  Also importable: ``validate(doc)`` /
+``validate_stream_line(doc)`` / ``validate_prometheus(text)`` each
+return ``list[str]`` (empty == valid).  ``tests/test_obs.py`` runs this
+against a live 2-iteration ``bench.py --metrics`` run so tier-1
+exercises the enabled path end to end.
 
 ``python scripts/validate_metrics.py --self-test`` checks the checker:
 a synthetic known-good document must validate clean and each of a set
@@ -17,13 +23,18 @@ of planted schema violations must be caught (run from
 from __future__ import annotations
 
 import json
+import re
 import sys
 from typing import Dict, List
 
 SCHEMA_NAME = "lightgbm-tpu-metrics"
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
+STREAM_SCHEMA_NAME = "lightgbm-tpu-stream"
+STREAM_SCHEMA_VERSION = 1
 
 _TIMING_KEYS = ("count", "total_s", "mean_s", "p50_s", "p95_s", "max_s")
+_ROLL_TIMING_KEYS = ("count", "total_s", "mean_s", "p50_s", "p95_s",
+                     "p99_s", "max_s")
 
 
 def _num(v) -> bool:
@@ -127,6 +138,192 @@ def validate(doc: Dict) -> List[str]:
             if not isinstance(v, int) or v < 0:
                 err(f"events.{k} is not a non-negative int")
 
+    rolling = doc.get("rolling", "MISSING")
+    if rolling == "MISSING":
+        err("rolling key missing (null is fine)")
+    elif rolling is not None:
+        errors.extend(_validate_rolling(rolling))
+
+    slo = doc.get("slo", "MISSING")
+    if slo == "MISSING":
+        err("slo key missing (null is fine)")
+    elif slo is not None:
+        errors.extend(_validate_slo_digest(slo))
+
+    return errors
+
+
+def _validate_rolling(roll) -> List[str]:
+    """The rolling-window block (snapshot ``rolling`` key / the body of
+    an exporter stream line): counter deltas+rates, gauge last/mean,
+    timing percentiles over the window."""
+    errors: List[str] = []
+    err = errors.append
+    if not isinstance(roll, dict):
+        return ["rolling is neither null nor an object"]
+    for k in ("bucket_s", "window_s", "now_unix"):
+        if not _num(roll.get(k)):
+            err(f"rolling.{k} missing or not a number")
+    counters = roll.get("counters")
+    if not isinstance(counters, dict):
+        err("rolling.counters missing or not an object")
+    else:
+        for k, v in counters.items():
+            if not isinstance(v, dict):
+                err(f"rolling counter {k!r} is not an object")
+                continue
+            d = v.get("delta")
+            if not isinstance(d, int) or isinstance(d, bool) or d < 0:
+                err(f"rolling counter {k!r}.delta is not a "
+                    f"non-negative int: {d!r}")
+            r = v.get("rate_per_s")
+            if not _num(r) or r < 0:
+                err(f"rolling counter {k!r}.rate_per_s is not a "
+                    f"non-negative number")
+    gauges = roll.get("gauges")
+    if not isinstance(gauges, dict):
+        err("rolling.gauges missing or not an object")
+    else:
+        for k, v in gauges.items():
+            if not isinstance(v, dict) or not _num(v.get("last")):
+                err(f"rolling gauge {k!r} needs a numeric 'last'")
+            elif v.get("mean") is not None and not _num(v["mean"]):
+                err(f"rolling gauge {k!r}.mean is neither null nor a "
+                    f"number")
+    timings = roll.get("timings")
+    if not isinstance(timings, dict):
+        err("rolling.timings missing or not an object")
+    else:
+        for name, stat in timings.items():
+            if not isinstance(stat, dict):
+                err(f"rolling timing {name!r} is not an object")
+                continue
+            for k in _ROLL_TIMING_KEYS:
+                if not _num(stat.get(k)):
+                    err(f"rolling timing {name!r} missing numeric {k!r}")
+            if all(_num(stat.get(k)) for k in _ROLL_TIMING_KEYS):
+                if stat["count"] < 1:
+                    err(f"rolling timing {name!r} has count < 1")
+                if stat["p50_s"] > stat["p95_s"] + 1e-9:
+                    err(f"rolling timing {name!r}: p50 > p95")
+                if stat["p95_s"] > stat["p99_s"] + 1e-9:
+                    err(f"rolling timing {name!r}: p95 > p99")
+                if stat["p99_s"] > stat["max_s"] + 1e-9:
+                    err(f"rolling timing {name!r}: p99 > max")
+    return errors
+
+
+def _validate_slo_digest(slo) -> List[str]:
+    """The compact SloReport digest (snapshot/stream ``slo`` key, bench
+    ``obs.slo``)."""
+    errors: List[str] = []
+    err = errors.append
+    if not isinstance(slo, dict):
+        return ["slo is neither null nor an object"]
+    if not isinstance(slo.get("ok"), bool):
+        err("slo.ok missing or not a bool")
+    if not _num(slo.get("window_s")):
+        err("slo.window_s missing or not a number")
+    objectives = slo.get("objectives")
+    if not isinstance(objectives, dict) or not objectives:
+        err("slo.objectives missing or empty")
+    else:
+        for name, o in objectives.items():
+            if not isinstance(o, dict):
+                err(f"slo objective {name!r} is not an object")
+                continue
+            if not isinstance(o.get("ok"), bool):
+                err(f"slo objective {name!r}.ok missing or not a bool")
+            if not _num(o.get("target")):
+                err(f"slo objective {name!r}.target is not a number")
+            if o.get("observed") is not None and not _num(o["observed"]):
+                err(f"slo objective {name!r}.observed is neither null "
+                    f"nor a number")
+        if (isinstance(slo.get("ok"), bool) and slo["ok"]
+                and any(isinstance(o, dict) and o.get("ok") is False
+                        for o in objectives.values())):
+            err("slo.ok is true but an objective failed")
+    return errors
+
+
+def validate_stream_line(doc: Dict) -> List[str]:
+    """One line of the exporter's JSONL time series
+    (``stream_path``)."""
+    if not isinstance(doc, dict):
+        return ["stream line is not a JSON object"]
+    errors: List[str] = []
+    if doc.get("schema") != STREAM_SCHEMA_NAME:
+        errors.append(f"stream schema != {STREAM_SCHEMA_NAME!r}: "
+                      f"{doc.get('schema')!r}")
+    if doc.get("schema_version") != STREAM_SCHEMA_VERSION:
+        errors.append(f"stream schema_version != "
+                      f"{STREAM_SCHEMA_VERSION}: "
+                      f"{doc.get('schema_version')!r}")
+    if not _num(doc.get("t_unix")):
+        errors.append("stream t_unix missing or not a number")
+    if doc.get("window_s") is None:
+        # rolling opted out (configure(rolling=False)): the exporter
+        # legitimately emits an empty-window line
+        for k in ("counters", "gauges", "timings"):
+            if doc.get(k) != {}:
+                errors.append(f"stream line without a rolling window "
+                              f"must carry an empty {k!r} object")
+    else:
+        errors.extend(_validate_rolling(
+            {k: doc.get(k) for k in ("bucket_s", "window_s", "now_unix",
+                                     "counters", "gauges", "timings")}))
+    if doc.get("slo") is not None:
+        errors.extend(_validate_slo_digest(doc["slo"]))
+    return errors
+
+
+_PROM_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_PROM_SAMPLE = re.compile(
+    r"^(?P<name>[^\s{]+)(?P<labels>\{[^}]*\})?\s+(?P<value>\S+)"
+    r"(\s+\S+)?$")
+
+
+def validate_prometheus(text: str) -> List[str]:
+    """Prometheus text-exposition checks: metric-name legality, legal
+    sample syntax, numeric values, no duplicate samples (same name +
+    label set), at most one TYPE per family."""
+    errors: List[str] = []
+    err = errors.append
+    seen_samples = set()
+    typed = set()
+    for ln, line in enumerate(text.splitlines(), 1):
+        line = line.rstrip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 3 and parts[1] == "TYPE":
+                fam = parts[2]
+                if not _PROM_NAME.match(fam):
+                    err(f"line {ln}: illegal metric family name {fam!r}")
+                if fam in typed:
+                    err(f"line {ln}: duplicate TYPE for family {fam!r}")
+                typed.add(fam)
+            continue
+        m = _PROM_SAMPLE.match(line)
+        if not m:
+            err(f"line {ln}: unparsable sample {line!r}")
+            continue
+        name = m.group("name")
+        if not _PROM_NAME.match(name):
+            err(f"line {ln}: illegal metric name {name!r}")
+        try:
+            float(m.group("value"))
+        except ValueError:
+            err(f"line {ln}: non-numeric sample value "
+                f"{m.group('value')!r}")
+        key = (name, m.group("labels") or "")
+        if key in seen_samples:
+            err(f"line {ln}: duplicate sample for {name}"
+                f"{m.group('labels') or ''}")
+        seen_samples.add(key)
+    if not seen_samples:
+        err("exposition has no samples")
     return errors
 
 
@@ -164,7 +361,49 @@ def _good_doc() -> Dict:
         "device_memory": {"bytes_in_use": 1024,
                           "peak_bytes_in_use": 4096},
         "events": {"recorded": 10, "dropped": 0},
+        "rolling": {
+            "bucket_s": 1.0, "window_s": 60.0,
+            "now_unix": 1700000001.0,
+            "counters": {"serve.ok": {"delta": 40,
+                                      "rate_per_s": 0.666667}},
+            "gauges": {"serve.degraded": {"last": 0, "mean": 0.0}},
+            "timings": {"serve.predict": {
+                "count": 40, "total_s": 0.08, "mean_s": 0.002,
+                "p50_s": 0.002, "p95_s": 0.0024, "p99_s": 0.0024,
+                "max_s": 0.0024}},
+        },
+        "slo": {
+            "ok": True, "window_s": 60.0,
+            "objectives": {
+                "availability": {"target": 0.999, "observed": 1.0,
+                                 "ok": True},
+                "p95_ms": {"target": 50.0, "observed": 2.4,
+                           "ok": True}},
+            "counts": {"ok": 40, "fallback": 0, "failed": 0,
+                       "input_errors": 0, "dark_fraction": 0.0},
+        },
     }
+
+
+def _good_stream_line() -> Dict:
+    roll = _good_doc()["rolling"]
+    return {"schema": STREAM_SCHEMA_NAME,
+            "schema_version": STREAM_SCHEMA_VERSION,
+            "t_unix": 1700000001.0, **roll,
+            "slo": _good_doc()["slo"]}
+
+
+_GOOD_PROM = """\
+# TYPE lgbm_serve_ok_total counter
+lgbm_serve_ok_total 40
+# TYPE lgbm_serve_degraded gauge
+lgbm_serve_degraded 0
+# TYPE lgbm_serve_predict_seconds summary
+lgbm_serve_predict_seconds{quantile="0.5"} 0.002
+lgbm_serve_predict_seconds{quantile="0.95"} 0.0024
+lgbm_serve_predict_seconds_sum 0.08
+lgbm_serve_predict_seconds_count 40
+"""
 
 
 def _mutate(doc: Dict, path, value) -> Dict:
@@ -203,6 +442,32 @@ _SELF_TEST_CASES = [
     ("device_memory key dropped", ("device_memory",), _DELETE,
      "device_memory"),
     ("negative dropped events", ("events", "dropped"), -2, "events"),
+    ("rolling key dropped", ("rolling",), _DELETE, "rolling"),
+    ("rolling counter negative delta",
+     ("rolling", "counters", "serve.ok", "delta"), -1, "delta"),
+    ("rolling timing p95 > p99",
+     ("rolling", "timings", "serve.predict", "p95_s"), 9.0, "p95 > p99"),
+    ("rolling gauge non-numeric last",
+     ("rolling", "gauges", "serve.degraded", "last"), "dark", "last"),
+    ("slo ok contradicts objectives",
+     ("slo", "objectives", "availability", "ok"), False,
+     "objective failed"),
+    ("slo objectives emptied", ("slo", "objectives"), {}, "objectives"),
+    ("slo non-bool ok", ("slo", "ok"), "yes", "slo.ok"),
+]
+
+#: (description, bad exposition text, substring the error must carry)
+_PROM_SELF_TEST_CASES = [
+    ("illegal metric name",
+     "# TYPE bad-name counter\nbad-name 1\n", "illegal metric name"),
+    ("duplicate sample",
+     "# TYPE lgbm_x_total counter\nlgbm_x_total 1\nlgbm_x_total 2\n",
+     "duplicate sample"),
+    ("duplicate TYPE",
+     "# TYPE lgbm_x gauge\n# TYPE lgbm_x gauge\nlgbm_x 1\n",
+     "duplicate TYPE"),
+    ("non-numeric value", "lgbm_x NaNope\n", "non-numeric"),
+    ("empty exposition", "# TYPE lgbm_x gauge\n", "no samples"),
 ]
 
 
@@ -225,12 +490,49 @@ def self_test() -> int:
             validate_training_run(disabled)):
         failures.append("disabled run not rejected by "
                         "validate_training_run")
+    # a snapshot without the streaming layer (rolling/slo null) is valid
+    nulled = dict(_good_doc(), rolling=None, slo=None)
+    errs = validate(nulled)
+    if errs:
+        failures.append(f"null rolling/slo rejected: {errs}")
+    # the stream-line and exposition validators check themselves too
+    errs = validate_stream_line(_good_stream_line())
+    if errs:
+        failures.append(f"good stream line rejected: {errs}")
+    bad_line = dict(_good_stream_line(), schema="other")
+    if not validate_stream_line(bad_line):
+        failures.append("stream line with wrong schema not caught")
+    # rolling-opted-out shape: window_s null + empty objects is valid,
+    # null window with leftover data is not
+    no_roll = {"schema": STREAM_SCHEMA_NAME,
+               "schema_version": STREAM_SCHEMA_VERSION,
+               "t_unix": 1700000001.0, "window_s": None,
+               "counters": {}, "gauges": {}, "timings": {}}
+    errs = validate_stream_line(no_roll)
+    if errs:
+        failures.append(f"rolling-disabled stream line rejected: {errs}")
+    if not validate_stream_line(dict(no_roll,
+                                     counters={"x": {"delta": 1}})):
+        failures.append("null-window stream line with counters not "
+                        "caught")
+    errs = validate_prometheus(_GOOD_PROM)
+    if errs:
+        failures.append(f"good exposition rejected: {errs}")
+    for desc, text, needle in _PROM_SELF_TEST_CASES:
+        errs = validate_prometheus(text)
+        if not errs:
+            failures.append(f"planted exposition defect not caught: "
+                            f"{desc}")
+        elif not any(needle in e for e in errs):
+            failures.append(
+                f"planted exposition defect {desc!r} caught with "
+                f"unexpected message(s): {errs}")
     if failures:
         for f in failures:
             print(f"SELF-TEST FAIL: {f}", file=sys.stderr)
         return 1
-    print(f"OK: validator self-test passed "
-          f"({len(_SELF_TEST_CASES) + 2} cases)")
+    n = len(_SELF_TEST_CASES) + len(_PROM_SELF_TEST_CASES) + 8
+    print(f"OK: validator self-test passed ({n} cases)")
     return 0
 
 
@@ -238,6 +540,35 @@ def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if argv == ["--self-test"]:
         return self_test()
+    if len(argv) == 2 and argv[0] == "--prom":
+        errors = validate_prometheus(open(argv[1]).read())
+        for e in errors:
+            print(f"INVALID: {e}", file=sys.stderr)
+        if not errors:
+            print(f"OK: {argv[1]} is valid Prometheus exposition")
+        return 1 if errors else 0
+    if len(argv) == 2 and argv[0] == "--stream":
+        errors = []
+        n_lines = 0
+        with open(argv[1]) as fh:
+            for i, line in enumerate(fh, 1):
+                if not line.strip():
+                    continue
+                n_lines += 1
+                try:
+                    doc = json.loads(line)
+                except ValueError as e:
+                    errors.append(f"line {i}: not JSON ({e})")
+                    continue
+                errors.extend(f"line {i}: {e}"
+                              for e in validate_stream_line(doc))
+        if not n_lines:
+            errors.append("stream file has no lines")
+        for e in errors:
+            print(f"INVALID: {e}", file=sys.stderr)
+        if not errors:
+            print(f"OK: {argv[1]} schema-valid ({n_lines} stream lines)")
+        return 1 if errors else 0
     if len(argv) != 1:
         print(__doc__, file=sys.stderr)
         return 2

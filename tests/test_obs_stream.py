@@ -1,0 +1,535 @@
+"""Streaming telemetry (docs/Observability.md "Streaming & SLOs"):
+rolling-window determinism over replayed timestamps, exporter
+bounded-queue drop semantics, SLO pass/fail boundary cases, Prometheus
+exposition rendering, serve request-outcome counters, and the
+per-window feature-gain telemetry events."""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.obs import slo
+from lightgbm_tpu.obs.export import (StreamExporter, prometheus_text,
+                                     sanitize_metric_name)
+from lightgbm_tpu.obs.rolling import HIST_BOUNDS, RollingRegistry
+from lightgbm_tpu.obs.state import STATE
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "validate_metrics", os.path.join(REPO, "scripts",
+                                     "validate_metrics.py"))
+validate_metrics = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(validate_metrics)
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    def clean():
+        exp = STATE.exporter
+        STATE.exporter = None
+        if exp is not None:
+            exp.stop(timeout_s=2.0)
+        obs.configure(enabled=False)
+        obs.reset()
+        STATE.rolling = None
+        STATE.rolling_opt_out = False
+        STATE.last_slo = None
+        STATE.pending_slo_spec = None
+        STATE.metrics_path = STATE.trace_path = STATE.events_path = None
+    clean()
+    yield
+    clean()
+
+
+T0 = 1_700_000_000.0
+
+
+def _replayed_registry():
+    r = RollingRegistry(bucket_seconds=1.0, num_buckets=60,
+                        clock=lambda: T0)
+    for i in range(100):
+        r.observe("serve.predict", 0.001 * (i + 1),
+                  now=T0 - 49 + i * 0.4)
+    r.inc("serve.ok", 7, now=T0 - 5)
+    r.inc("serve.ok", 3, now=T0 - 30)
+    r.inc("serve.ok", 99, now=T0 - 300)        # far outside the ring
+    r.set_gauge("serve.degraded", 1, now=T0 - 50)
+    r.set_gauge("serve.degraded", 0, now=T0 - 20)
+    return r
+
+
+class TestRollingWindow:
+    def test_replayed_timestamps_are_deterministic(self):
+        a = _replayed_registry().window(60.0, T0)
+        b = _replayed_registry().window(60.0, T0)
+        assert a == b
+        # percentiles are fixed bucket bounds (clamped to window max):
+        # the defining property that makes replayed runs byte-identical
+        t = a["timings"]["serve.predict"]
+        for key in ("p50_s", "p95_s", "p99_s"):
+            assert any(abs(t[key] - round(b, 6)) < 1e-12
+                       for b in HIST_BOUNDS) or t[key] == t["max_s"]
+        assert t["count"] == 100
+        assert t["p50_s"] <= t["p95_s"] <= t["p99_s"] <= t["max_s"]
+
+    def test_counter_delta_and_window_expiry(self):
+        r = _replayed_registry()
+        assert r.counter_delta("serve.ok", 60.0, T0) == 10
+        # a 10 s window sees only the T0-5 increment
+        assert r.counter_delta("serve.ok", 10.0, T0) == 7
+        # everything expires once the window slides past it
+        assert r.counter_delta("serve.ok", 60.0, T0 + 120) == 0
+        snap = r.window(60.0, T0)
+        assert snap["counters"]["serve.ok"]["delta"] == 10
+        assert snap["counters"]["serve.ok"]["rate_per_s"] == \
+            pytest.approx(10 / 60.0, abs=1e-6)
+
+    def test_gauge_time_weighted_mean(self):
+        r = _replayed_registry()
+        # degraded 1 from T0-50 to T0-20, 0 after: integration starts
+        # at the first known transition -> (30*1 + 20*0) / 50
+        assert r.gauge_mean("serve.degraded", 60.0, T0) == \
+            pytest.approx(0.6)
+        assert r.gauge_last("serve.degraded") == 0
+        # value carries FORWARD past the last transition
+        assert r.gauge_mean("serve.degraded", 10.0, T0) == \
+            pytest.approx(0.0)
+        assert r.gauge_mean("never.set", 60.0, T0) is None
+
+    def test_timing_window_excludes_old_samples(self):
+        r = RollingRegistry(bucket_seconds=1.0, num_buckets=60)
+        r.observe("lat", 5.0, now=T0 - 59)
+        r.observe("lat", 0.001, now=T0 - 1)
+        full = r.timing_stats("lat", 60.0, T0)
+        assert full["count"] == 2 and full["max_s"] == 5.0
+        recent = r.timing_stats("lat", 10.0, T0)
+        assert recent["count"] == 1
+        assert recent["p99_s"] <= 0.0015
+
+    def test_out_of_order_late_write_is_dropped(self):
+        r = RollingRegistry(bucket_seconds=1.0, num_buckets=4)
+        r.inc("c", 1, now=T0)
+        r.inc("c", 1, now=T0 - 100)    # slot now owned by a newer epoch
+        assert r.counter_delta("c", 4.0, T0) == 1
+        # gauges obey the same contract: a late write never rewinds
+        # gauge_last nor creates a negative-weight segment
+        r.set_gauge("g", 2, now=T0)
+        r.set_gauge("g", 7, now=T0 - 5)
+        assert r.gauge_last("g") == 2
+        assert r.gauge_mean("g", 4.0, T0) == pytest.approx(2.0)
+
+
+class TestSloBoundaries:
+    def _base(self, ok=999, failed=1, dark=None):
+        r = RollingRegistry(bucket_seconds=1.0, num_buckets=120,
+                            clock=lambda: T0)
+        if ok:
+            r.inc("serve.ok", ok, now=T0 - 1)
+        if failed:
+            r.inc("serve.failed", failed, now=T0 - 1)
+        if dark is not None:
+            r.set_gauge("serve.degraded", dark, now=T0 - 119)
+        return r
+
+    def test_availability_exact_boundary_passes(self):
+        r = self._base(ok=999, failed=1)
+        spec = slo.SloSpec.parse("availability>=0.999,window_s=120")
+        rep = spec.evaluate(rolling=r, now=T0)
+        assert rep.objective("availability").observed == \
+            pytest.approx(0.999)
+        assert rep.ok
+
+    def test_availability_below_boundary_fails(self):
+        r = self._base(ok=998, failed=2)
+        rep = slo.SloSpec.parse("availability>=0.999,window_s=120") \
+            .evaluate(rolling=r, now=T0)
+        assert not rep.ok
+
+    def test_input_errors_do_not_count_against_availability(self):
+        r = self._base(ok=10, failed=0)
+        r.inc("serve.input_errors", 500, now=T0 - 1)
+        rep = slo.SloSpec.parse("availability>=1.0,window_s=120") \
+            .evaluate(rolling=r, now=T0)
+        assert rep.ok
+        assert rep.counts["input_errors"] == 500
+
+    def test_dark_time_counts_against_availability(self):
+        # every request answered (by fallback), but the breaker was
+        # open the whole window: availability collapses to ~0
+        r = self._base(ok=0, failed=0, dark=1)
+        r.inc("serve.fallback_requests", 100, now=T0 - 1)
+        rep = slo.SloSpec.parse("availability>=0.999,window_s=120") \
+            .evaluate(rolling=r, now=T0)
+        avail = rep.objective("availability")
+        assert not avail.ok and avail.observed < 0.05
+        assert rep.counts["dark_fraction"] > 0.9
+
+    def test_latency_boundary(self):
+        r = self._base()
+        for _ in range(100):
+            r.observe("serve.predict", 0.010, now=T0 - 1)
+        spec = slo.SloSpec.parse("availability>=0.5,window_s=120,"
+                                 "p95_ms<=100")
+        rep = spec.evaluate(rolling=r, now=T0)
+        p95 = rep.objective("p95_ms")
+        assert p95.ok and p95.observed == pytest.approx(10.0)
+        # a bound exactly AT the observed value still passes (<= + eps)
+        tight = slo.SloSpec.parse(
+            f"availability>=0.5,window_s=120,p95_ms<={p95.observed}")
+        assert tight.evaluate(rolling=r, now=T0).objective("p95_ms").ok
+        below = slo.SloSpec.parse("availability>=0.5,window_s=120,"
+                                  "p95_ms<=9.9")
+        assert not below.evaluate(rolling=r, now=T0).objective(
+            "p95_ms").ok
+
+    def test_no_latency_samples_fails_with_detail(self):
+        r = self._base()
+        rep = slo.SloSpec.parse("p95_ms<=100,window_s=120") \
+            .evaluate(rolling=r, now=T0)
+        o = rep.objective("p95_ms")
+        assert not o.ok and o.observed is None and "no" in o.detail
+
+    def test_burn_rate(self):
+        # availability 0.99 against a 0.999 target: burning the error
+        # budget at 10x
+        r = self._base(ok=990, failed=10)
+        rep = slo.SloSpec.parse(
+            "availability>=0.999,burn<=10,window_s=120") \
+            .evaluate(rolling=r, now=T0)
+        burn = rep.objective("burn")
+        assert burn.observed == pytest.approx(10.0)
+        assert burn.ok                      # exactly at the bound
+        rep2 = slo.SloSpec.parse(
+            "availability>=0.999,burn<=9.5,window_s=120") \
+            .evaluate(rolling=r, now=T0)
+        assert not rep2.objective("burn").ok
+
+    def test_freshness(self):
+        r = self._base()
+        r.set_gauge("pipeline.last_swap_unix", T0 - 12, now=T0 - 12)
+        rep = slo.SloSpec.parse("freshness_s<=30,window_s=120") \
+            .evaluate(rolling=r, now=T0)
+        f = rep.objective("freshness_s")
+        assert f.ok and f.observed == pytest.approx(12.0)
+        assert not slo.SloSpec.parse("freshness_s<=5,window_s=120") \
+            .evaluate(rolling=r, now=T0).objective("freshness_s").ok
+        # never swapped -> objective fails with a detail, not a crash
+        bare = self._base()
+        o = slo.SloSpec.parse("freshness_s<=30,window_s=120") \
+            .evaluate(rolling=bare, now=T0).objective("freshness_s")
+        assert not o.ok and o.observed is None
+
+    def test_spec_parse_errors(self):
+        for bad in ("", "availability<=0.9", "p95_ms>=5", "burn<=2",
+                    "nonsense>=1", "availability>=2.0",
+                    "availability>=x"):
+            with pytest.raises(slo.SloSpecError):
+                slo.SloSpec.parse(bad)
+
+    def test_window_beyond_ring_capacity_raises(self):
+        # a silently clamped window would turn an outage older than
+        # the ring into a FALSE PASS — the evaluator must error loudly
+        r = RollingRegistry(bucket_seconds=1.0, num_buckets=120,
+                            clock=lambda: T0)
+        r.inc("serve.ok", 5, now=T0 - 1)
+        spec = slo.SloSpec.parse("availability>=0.999,window_s=600")
+        with pytest.raises(slo.SloSpecError, match="capacity"):
+            spec.evaluate(rolling=r, now=T0)
+        # a registry actually built for 600 s evaluates fine
+        big = RollingRegistry(bucket_seconds=5.0, num_buckets=120,
+                              clock=lambda: T0)
+        big.inc("serve.ok", 5, now=T0 - 1)
+        assert spec.evaluate(rolling=big, now=T0).ok
+
+    def test_source_prefix(self):
+        r = RollingRegistry(clock=lambda: T0)
+        r.inc("serve.fleet.ok", 50, now=T0 - 1)
+        rep = slo.SloSpec.parse(
+            "source=serve.fleet,availability>=0.999,window_s=60") \
+            .evaluate(rolling=r, now=T0)
+        assert rep.ok and rep.counts["ok"] == 50
+
+
+class TestExporter:
+    def test_jammed_queue_drops_and_counts(self, tmp_path):
+        obs.configure(enabled=True)
+        obs.inc("serve.ok", 3)
+        exp = StreamExporter(stream_path=str(tmp_path / "s.jsonl"),
+                             queue_max=2)
+        # writer not started: the bounded queue jams after 2 offers and
+        # every further emit() drops NON-BLOCKINGLY
+        for _ in range(5):
+            exp.emit()
+        assert exp.dropped == 3
+        assert obs.registry().counter("export.dropped") == 3
+        # draining the jam writes the two queued snapshots
+        exp.start()
+        exp.stop()
+        lines = [json.loads(ln)
+                 for ln in open(tmp_path / "s.jsonl")]
+        assert len(lines) >= 2
+        for doc in lines:
+            assert validate_metrics.validate_stream_line(doc) == []
+
+    def test_prom_file_and_stream_validate(self, tmp_path):
+        obs.configure(enabled=True)
+        obs.inc("serve.ok", 4)
+        obs.observe("serve.predict", 0.002)
+        obs.set_gauge("serve.degraded", 0)
+        sp, pp = str(tmp_path / "s.jsonl"), str(tmp_path / "m.prom")
+        exp = StreamExporter(stream_path=sp, prom_path=pp)
+        exp.flush_now()
+        assert validate_metrics.validate_prometheus(open(pp).read()) \
+            == []
+        doc = json.loads(open(sp).readline())
+        assert validate_metrics.validate_stream_line(doc) == []
+        assert doc["counters"]["serve.ok"]["delta"] == 4
+
+    def test_write_errors_counted_not_raised(self, tmp_path):
+        obs.configure(enabled=True)
+        exp = StreamExporter(
+            stream_path=str(tmp_path / "no_such_dir" / "s.jsonl"))
+        exp.flush_now()      # must not raise
+        assert exp.write_errors == 1
+        assert obs.registry().counter("export.write_errors") == 1
+
+    def test_configure_idempotent_per_window(self, tmp_path):
+        sp = str(tmp_path / "s.jsonl")
+        obs.configure(enabled=True, stream_path=sp)
+        first = STATE.exporter
+        # the per-window configure_from_config path: same target, no
+        # thread churn
+        obs.configure(enabled=True, stream_path=sp)
+        assert STATE.exporter is first
+
+    def test_partial_reconfigure_is_additive(self, tmp_path):
+        # env-started stream + param-added prom must coexist: a
+        # partial reconfigure inherits the running exporter's targets
+        sp, pp = str(tmp_path / "s.jsonl"), str(tmp_path / "m.prom")
+        obs.configure(enabled=True, stream_path=sp)
+        obs.configure(enabled=True, prom_path=pp)
+        assert STATE.exporter.stream_path == sp
+        assert STATE.exporter.prom_path == pp
+
+    def test_unevaluable_spec_is_counted_not_silent(self, tmp_path):
+        # parses fine, but the window exceeds the default ring: each
+        # tick must count the failure (and warn once), never crash
+        obs.configure(enabled=True)
+        exp = StreamExporter(stream_path=str(tmp_path / "s.jsonl"),
+                             slo_spec="availability>=0.999,window_s=900")
+        exp.flush_now()
+        exp.flush_now()
+        assert obs.registry().counter("export.slo_errors") == 2
+        for ln in open(tmp_path / "s.jsonl"):
+            assert "slo" not in json.loads(ln)
+
+    def test_malformed_slo_spec_raises_at_configure(self, tmp_path):
+        with pytest.raises(slo.SloSpecError):
+            StreamExporter(stream_path=str(tmp_path / "s.jsonl"),
+                           slo_spec="availabilty>=0.999")   # typo
+        with pytest.raises(slo.SloSpecError):
+            obs.configure(enabled=True,
+                          stream_path=str(tmp_path / "s2.jsonl"),
+                          slo_spec="p95_ms>=5")
+        # ...and even with no exporter at all: the spec is validated,
+        # not silently dropped
+        with pytest.raises(slo.SloSpecError):
+            obs.configure(enabled=True, slo_spec="availabilty>=0.999")
+
+    def test_slo_spec_without_exporter_adopted_later(self, tmp_path):
+        # configure(slo_spec=) before any export target: the spec is
+        # stashed and the next exporter start picks it up
+        obs.configure(enabled=True, slo_spec="availability>=0.999")
+        assert STATE.pending_slo_spec is not None
+        obs.inc("serve.ok", 5)
+        sp = str(tmp_path / "s.jsonl")
+        obs.configure(enabled=True, stream_path=sp)
+        obs.flush()
+        doc = json.loads(open(sp).readline())
+        assert doc["slo"]["ok"] is True
+
+    def test_failing_evaluation_clears_stale_digest(self, tmp_path):
+        obs.configure(enabled=True)
+        obs.inc("serve.ok", 5)
+        sp = str(tmp_path / "s.jsonl")
+        exp = StreamExporter(stream_path=sp,
+                             slo_spec="availability>=0.9")
+        exp.flush_now()
+        assert STATE.last_slo is not None
+        # the rolling mirror disappears: evaluation starts failing and
+        # the stale "ok" digest must stop riding on fresh lines
+        STATE.rolling = None
+        exp.flush_now()
+        assert STATE.last_slo is None
+        last = json.loads(open(sp).readlines()[-1])
+        assert "slo" not in last
+
+    def test_rolling_opt_out_is_sticky(self):
+        obs.configure(enabled=True, rolling=False)
+        assert STATE.rolling is None
+        # the per-window configure_from_config path must not undo it
+        obs.configure(enabled=True)
+        assert STATE.rolling is None
+        obs.configure(enabled=True, rolling=True)
+        assert STATE.rolling is not None
+
+    def test_scrape_endpoint(self, tmp_path):
+        from urllib.request import urlopen
+        obs.configure(enabled=True)
+        obs.inc("serve.ok", 2)
+        exp = StreamExporter(http_port=0).start()
+        try:
+            exp.flush_now()
+            body = urlopen(
+                f"http://127.0.0.1:{exp.http_port}/metrics",
+                timeout=5).read().decode()
+        finally:
+            exp.stop()
+        assert validate_metrics.validate_prometheus(body) == []
+        assert "lgbm_serve_ok_total 2" in body
+
+
+class TestPrometheusText:
+    def test_sanitize_and_dedup(self):
+        assert sanitize_metric_name("serve.fleet.tenant.0.rows") == \
+            "lgbm_serve_fleet_tenant_0_rows"
+        # two raw names colliding after sanitization: one sample, one
+        # collision — never a duplicate-sample exposition
+        snap = {"counters": {"a.b": 1, "a_b": 2}, "gauges": {},
+                "timings": {}}
+        text, collisions = prometheus_text(snap)
+        assert collisions == 1
+        assert validate_metrics.validate_prometheus(text) == []
+
+    def test_summary_quantiles_prefer_rolling(self):
+        cum = {"counters": {}, "gauges": {},
+               "timings": {"serve.predict": {
+                   "count": 10, "total_s": 1.0, "mean_s": 0.1,
+                   "p50_s": 0.1, "p95_s": 0.2, "max_s": 0.3}}}
+        roll = {"timings": {"serve.predict": {
+            "count": 4, "total_s": 0.02, "mean_s": 0.005,
+            "p50_s": 0.004, "p95_s": 0.006, "p99_s": 0.007,
+            "max_s": 0.008}}}
+        text, _ = prometheus_text(cum, roll)
+        assert 'quantile="0.5"} 0.004' in text      # rolling, not 0.1
+        assert "_sum 1" in text                     # cumulative sum
+        assert validate_metrics.validate_prometheus(text) == []
+
+
+def _small_booster(rounds=4):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((500, 6))
+    y = (x[:, 0] + x[:, 1] ** 2 > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "metric": "none", "min_data_in_leaf": 5}
+    bst = lgb.train(params, lgb.Dataset(x, label=y),
+                    num_boost_round=rounds, verbose_eval=False)
+    return bst, x
+
+
+class TestServeOutcomeCounters:
+    def test_healthy_prefix_anchors_dark_fraction(self):
+        # every device success writes serve.degraded=0, so a breaker
+        # trip late in a window integrates as a PARTIAL dark fraction,
+        # not a full-window outage
+        from lightgbm_tpu.serve.engine import PredictionServer
+        obs.configure(enabled=True)
+        bst, x = _small_booster()
+        srv = PredictionServer(bst)
+        srv.predict(x[:64])
+        trans = STATE.rolling._gauges.get("serve.degraded")
+        assert trans and trans[-1][1] == 0
+
+    def test_ok_and_input_error_distinguished(self):
+        from lightgbm_tpu.serve.engine import PredictionServer
+        from lightgbm_tpu.utils.log import LightGBMError
+        obs.configure(enabled=True)
+        bst, x = _small_booster()
+        srv = PredictionServer(bst)
+        srv.predict(x[:64])
+        assert obs.registry().counter("serve.ok") == 1
+        assert STATE.rolling.counter_delta("serve.ok") == 1
+        with pytest.raises(LightGBMError):
+            srv.predict(x[:8, :2])       # too narrow: an input fault
+        assert obs.registry().counter("serve.input_errors") == 1
+        assert obs.registry().counter("serve.failed") == 0
+
+    def test_breaker_live_dark_seconds(self):
+        from lightgbm_tpu.robust import CircuitBreaker
+        t = [100.0]
+        br = CircuitBreaker(failure_threshold=1, reprobe_interval_s=50,
+                            clock=lambda: t[0])
+        assert br.dark_seconds() == 0.0
+        br.record_failure()              # trips at t=100
+        t[0] = 103.0
+        # still open: live accounting, no recovery needed
+        assert br.dark_seconds() == pytest.approx(3.0)
+        assert br.record_success() == pytest.approx(3.0)
+        assert br.dark_seconds() == pytest.approx(3.0)   # accumulated
+        br.record_failure()
+        t[0] = 105.0
+        assert br.dark_seconds() == pytest.approx(5.0)
+
+    def test_slo_over_live_serving(self):
+        from lightgbm_tpu.serve.engine import PredictionServer
+        obs.configure(enabled=True)
+        bst, x = _small_booster()
+        srv = PredictionServer(bst)
+        for _ in range(10):
+            srv.predict(x[:64])
+        rep = slo.evaluate("availability>=0.999,p95_ms<=60000")
+        assert rep.ok and rep.counts["ok"] == 10
+        assert obs.summary()["slo"]["ok"] is True
+        assert obs.snapshot()["slo"]["ok"] is True
+
+
+class TestWindowFeatureTelemetry:
+    def test_per_window_gain_events(self, tmp_path):
+        from lightgbm_tpu.pipeline import PreppedWindow, RetrainPipeline
+        obs.configure(enabled=True)
+
+        def prep(w):
+            rng = np.random.default_rng(100 + w)
+            x = rng.standard_normal((800, 6))
+            y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float64)
+            return PreppedWindow(label=y, dense=x)
+
+        pipe = RetrainPipeline(
+            {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+             "metric": "none", "num_iterations": 3,
+             "min_data_in_leaf": 5},
+            window_policy="fresh", rebin_on_drift=False, serve=False,
+            pipelined=False)
+        pipe.run(range(2), prep)
+
+        path = tmp_path / "events.jsonl"
+        obs.dump_events_jsonl(str(path))
+        events = [json.loads(ln) for ln in open(path)]
+        feats = [e for e in events
+                 if e["name"] == "pipeline.window_features"]
+        assert len(feats) == 2
+        windows = sorted(e["args"]["window"] for e in feats)
+        assert windows == [0, 1]
+        for e in feats:
+            args = e["args"]
+            assert e["kind"] == "instant" and e["cat"] == "pipeline"
+            assert args["policy"] == "fresh"
+            assert args["features"] == 6
+            assert args["total_gain"] > 0
+            assert args["top"], "no features with positive gain?"
+            for f, gain, splits in args["top"]:
+                assert isinstance(f, int) and 0 <= f < 6
+                assert gain > 0 and isinstance(splits, int)
+            # split counts are bounded by the ensemble's split total
+            assert sum(t[2] for t in args["top"]) <= 3 * 6
+        assert obs.registry().counter("pipeline.feature_events") == 2
+        assert obs.registry().gauge("pipeline.gain_top_share") > 0
+        # the freshness anchor only lands when serving swaps; with
+        # serve=False it must stay unset rather than lie
+        assert obs.registry().gauge("pipeline.last_swap_unix") is None
