@@ -34,6 +34,8 @@ import os
 import time
 from typing import Dict, Optional, Tuple
 
+from . import profile  # noqa: F401  (re-export)
+from . import tracing  # noqa: F401  (re-export)
 from .jit_track import track_jit  # noqa: F401  (re-export)
 from .registry import MetricsRegistry  # noqa: F401  (re-export)
 from .rolling import RollingRegistry
@@ -44,9 +46,9 @@ SCHEMA_VERSION = 2
 
 __all__ = [
     "enabled", "configure", "configure_from_config", "reset", "registry",
-    "rolling", "rolling_snapshot",
-    "inc", "set_gauge", "max_gauge", "observe", "span", "instant",
-    "counter_sample", "track_jit", "sample_device_memory",
+    "rolling", "rolling_snapshot", "tracing", "profile",
+    "inc", "set_gauge", "max_gauge", "observe", "span", "span_event",
+    "instant", "counter_sample", "track_jit", "sample_device_memory",
     "device_memory_stats", "snapshot", "summary", "dump_metrics",
     "dump_trace", "dump_events_jsonl", "flush", "iteration_hooks",
 ]
@@ -79,7 +81,9 @@ def configure(enabled: Optional[bool] = None,
               prom_path: Optional[str] = None,
               export_interval_s: Optional[float] = None,
               http_port: Optional[int] = None,
-              slo_spec=None) -> None:
+              slo_spec=None,
+              trace_context: Optional[bool] = None,
+              profile_attribution: Optional[bool] = None) -> None:
     """Update the global observability state.
 
     Additive: ``None`` leaves a setting untouched, and enabling twice
@@ -94,6 +98,9 @@ def configure(enabled: Optional[bool] = None,
     :class:`~.export.StreamExporter`, flushing every
     ``export_interval_s`` seconds (default 5); ``slo_spec`` makes each
     flush carry a fresh SLO evaluation (docs/Observability.md).
+    ``trace_context`` turns causal span propagation on/off
+    (obs/tracing.py); ``profile_attribution`` attaches XLA
+    cost-analysis FLOPs/bytes to the profile probes (obs/profile.py).
     """
     if metrics_path:
         STATE.metrics_path = metrics_path
@@ -103,6 +110,10 @@ def configure(enabled: Optional[bool] = None,
         STATE.events_path = events_path
     if sync is not None:
         STATE.sync = bool(sync)
+    if trace_context is not None:
+        STATE.trace_context = bool(trace_context)
+    if profile_attribution is not None:
+        STATE.profile_attribution = bool(profile_attribution)
     if enabled is not None:
         was = STATE.enabled
         STATE.enabled = bool(enabled)
@@ -189,8 +200,11 @@ def configure_from_config(cfg) -> None:
     stream_path = str(getattr(cfg, "stream_path", "") or "")
     prom_path = str(getattr(cfg, "prom_path", "") or "")
     http_port = int(getattr(cfg, "obs_http_port", 0) or 0)
+    trace_ctx = bool(getattr(cfg, "trace_context_enabled", False))
+    profile_attr = bool(getattr(cfg, "profile_attribution", False))
     if not (want or trace_path or metrics_path or events_path
-            or stream_path or prom_path or http_port):
+            or stream_path or prom_path or http_port or trace_ctx
+            or profile_attr):
         return
     configure(enabled=True, metrics_path=metrics_path or None,
               trace_path=trace_path or None,
@@ -199,7 +213,11 @@ def configure_from_config(cfg) -> None:
               prom_path=prom_path or None,
               export_interval_s=float(getattr(
                   cfg, "obs_export_interval", 0) or 0) or None,
-              http_port=http_port if http_port > 0 else None)
+              http_port=http_port if http_port > 0 else None,
+              # additive like every other setting: a later window's
+              # config without the flag must not disable propagation
+              trace_context=True if trace_ctx else None,
+              profile_attribution=True if profile_attr else None)
 
 
 def reset() -> None:
@@ -277,13 +295,29 @@ _NULL_SPAN = _NullSpan()
 
 
 class _Span:
-    __slots__ = ("name", "cat", "args", "t0", "sync_value")
+    __slots__ = ("name", "cat", "args", "t0", "sync_value",
+                 "trace_id", "span_id", "parent_id", "_ctx_token")
 
     def __init__(self, name, cat, args):
         self.name = name
         self.cat = cat
         self.args = args
         self.sync_value = None
+        if STATE.trace_context:
+            # becomes the current context for everything opened inside
+            # this span on this thread (obs/tracing.py); a cross-thread
+            # parent arrives via tracing.set_current before the span
+            parent = tracing._CURRENT.get()
+            self.trace_id = (parent.trace_id if parent is not None
+                             else tracing.new_id())
+            self.span_id = tracing.new_id()
+            self.parent_id = (parent.span_id if parent is not None
+                              else None)
+            self._ctx_token = tracing._CURRENT.set(
+                tracing.SpanContext(self.trace_id, self.span_id))
+        else:
+            self.trace_id = self.span_id = self.parent_id = None
+            self._ctx_token = None
         self.t0 = time.perf_counter()
 
     def set(self, **args):
@@ -294,6 +328,9 @@ class _Span:
         return self
 
     def __exit__(self, *exc):
+        if self._ctx_token is not None:
+            tracing._CURRENT.reset(self._ctx_token)
+            self._ctx_token = None
         if STATE.sync and self.sync_value is not None:
             import jax
             jax.block_until_ready(self.sync_value)
@@ -302,6 +339,11 @@ class _Span:
         r = STATE.rolling
         if r is not None:
             r.observe(self.name, dur)
+        if self.span_id is not None:
+            self.args["trace_id"] = self.trace_id
+            self.args["span_id"] = self.span_id
+            if self.parent_id is not None:
+                self.args["parent_id"] = self.parent_id
         STATE.trace.add(self.name, cat=self.cat, t0=self.t0, dur=dur,
                         args=self.args or None)
         return False
@@ -318,6 +360,17 @@ def span(name: str, cat: str = "train", **args):
     if not STATE.enabled:
         return _NULL_SPAN
     return _Span(name, cat, dict(args) if args else {})
+
+
+def span_event(name: str, t0: float, dur: float, cat: str = "serve",
+               **args) -> None:
+    """Record a completed span from explicit timestamps — for work
+    whose start/end were observed on different threads (a micro-batch
+    request: submit on the caller, flush on the worker).  Pass
+    ``trace_id``/``parent_id`` args (``tracing.link_args``) to place it
+    in a causal chain."""
+    if STATE.enabled:
+        STATE.trace.add(name, cat=cat, t0=t0, dur=dur, args=args or None)
 
 
 def instant(name: str, cat: str = "train", **args) -> None:
@@ -657,9 +710,12 @@ def _configure_from_env() -> None:
         http_port = int(os.environ.get("LGBM_TPU_OBS_HTTP", "") or 0)
     except ValueError:
         http_port = 0
+    trace_ctx = os.environ.get("LGBM_TPU_TRACE_CTX", "").lower() \
+        in ("1", "true", "yes")
     if metrics.lower() in ("0", "false", "no"):
         metrics = ""
-    if not (metrics or trace or events or stream or prom or http_port):
+    if not (metrics or trace or events or stream or prom or http_port
+            or trace_ctx):
         return
     configure(
         enabled=True,
@@ -671,6 +727,7 @@ def _configure_from_env() -> None:
         prom_path=prom or None,
         http_port=http_port if http_port > 0 else None,
         sync=os.environ.get("LGBM_TPU_OBS_SYNC", "") in ("1", "true"),
+        trace_context=True if trace_ctx else None,
     )
 
 

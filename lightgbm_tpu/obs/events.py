@@ -46,6 +46,10 @@ class TraceBuffer:
         self._lock = threading.Lock()
         self._events: List[Event] = []
         self.dropped = 0
+        # tid -> thread name at first sight, so exports can label the
+        # prep/serve/fleet worker lanes (Perfetto reads thread_name
+        # metadata; raw tids interleave unreadably)
+        self._thread_names: Dict[int, str] = {}
         # perf_counter origin and the wall-clock it corresponds to, so
         # JSONL lines carry absolute times while chrome ts stay relative
         self.epoch_perf = time.perf_counter()
@@ -58,10 +62,13 @@ class TraceBuffer:
     def add(self, name: str, *, cat: str = "train", kind: str = "span",
             t0: Optional[float] = None, dur: float = 0.0,
             args: Optional[Dict] = None) -> None:
+        tid = threading.get_ident()
         ev = Event(name, cat, kind,
                    time.perf_counter() if t0 is None else t0,
-                   dur, threading.get_ident(), args or {})
+                   dur, tid, args or {})
         with self._lock:
+            if tid not in self._thread_names:
+                self._thread_names[tid] = threading.current_thread().name
             if len(self._events) >= MAX_EVENTS:
                 self.dropped += 1
                 return
@@ -71,8 +78,14 @@ class TraceBuffer:
         with self._lock:
             self._events.clear()
             self.dropped = 0
+            self._thread_names.clear()
             self.epoch_perf = time.perf_counter()
             self.epoch_unix = time.time()
+
+    def thread_names(self) -> Dict[int, str]:
+        """tid -> thread name for every thread that recorded an event."""
+        with self._lock:
+            return dict(self._thread_names)
 
     def _copy(self) -> List[Event]:
         with self._lock:
@@ -82,6 +95,7 @@ class TraceBuffer:
     def to_jsonl(self, path: str) -> int:
         """One JSON object per line; returns the number written."""
         events = self._copy()
+        names = self.thread_names()
         with open(path, "w") as fh:
             for ev in events:
                 rec = {
@@ -91,6 +105,7 @@ class TraceBuffer:
                     "cat": ev.cat,
                     "kind": ev.kind,
                     "tid": ev.tid,
+                    "thread": names.get(ev.tid, ""),
                 }
                 if ev.kind == "span":
                     rec["dur_s"] = round(ev.dur, 6)
@@ -111,6 +126,12 @@ class TraceBuffer:
             "name": "process_name", "ph": "M", "pid": 0, "tid": 0,
             "args": {"name": "lightgbm_tpu"},
         }]
+        # one thread_name metadata event per recording thread: Perfetto
+        # labels the lanes (prep / serve / fleet workers) instead of
+        # showing raw interleaved tids
+        for tid, tname in sorted(self.thread_names().items()):
+            out.append({"name": "thread_name", "ph": "M", "pid": 0,
+                        "tid": tid, "args": {"name": tname}})
         for ev in events:
             ts = (ev.t0 - self.epoch_perf) * 1e6
             base = {"name": ev.name, "cat": ev.cat, "pid": 0,

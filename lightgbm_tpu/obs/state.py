@@ -17,7 +17,8 @@ from .registry import MetricsRegistry
 
 
 class ObsState:
-    __slots__ = ("enabled", "sync", "registry", "trace", "rolling",
+    __slots__ = ("enabled", "sync", "trace_context",
+                 "profile_attribution", "registry", "trace", "rolling",
                  "rolling_opt_out", "exporter", "last_slo",
                  "pending_slo_spec",
                  "metrics_path", "trace_path", "events_path",
@@ -30,6 +31,13 @@ class ObsState:
         # before stopping the clock (honest attribution; serialises the
         # pipeline — leave off for production runs)
         self.sync = False
+        # causal trace-context propagation (obs/tracing.py): spans gain
+        # trace_id/span_id/parent_id and contexts flow across the
+        # pipeline/serve thread boundaries; off = zero context objects
+        self.trace_context = False
+        # attach XLA cost-analysis (FLOPs / bytes) to the profile
+        # probes (obs/profile.py; bench.py --explain turns it on)
+        self.profile_attribution = False
         self.registry = MetricsRegistry()
         self.trace = TraceBuffer()
         # rolling-window mirror of the registry (obs/rolling.py) —

@@ -1622,7 +1622,8 @@ class DeviceGrower:
             # byte-stable default ladder (a profiled plan would also
             # have to match across mesh sizes to preserve the
             # byte-identity contract, docs/Sharding.md)
-            return {"stage_ms": {}, "fixed_ms": None, "col_ms": None,
+            return {"stage_ms": {}, "stage_cost": {}, "fixed_ms": None,
+                    "col_ms": None,
                     "plan": list(progs.stage_plan),
                     "plan_digest":
                         stage_plan_mod.plan_digest(progs.stage_plan),
@@ -1630,7 +1631,8 @@ class DeviceGrower:
         if install and progs.plan_source in ("profiled", "persisted"):
             # already measured for this signature in this process, or
             # adopted from the on-disk store: zero re-profiles
-            return {"stage_ms": {}, "fixed_ms": None, "col_ms": None,
+            return {"stage_ms": {}, "stage_cost": {}, "fixed_ms": None,
+                    "col_ms": None,
                     "plan": list(progs.stage_plan),
                     "plan_digest":
                         stage_plan_mod.plan_digest(progs.stage_plan),
@@ -1660,6 +1662,7 @@ class DeviceGrower:
                         progs._wave_hist(b, l, g2, p, wave_scales)))
             return fn, leaf, ghk, pend
 
+        stage_cost = {}
         for w in widths:
             fn, leaf, ghk, pend = probe_for(w)
             jax.block_until_ready(fn(self.binned, leaf, ghk, pend))
@@ -1671,6 +1674,17 @@ class DeviceGrower:
                 jax.block_until_ready(r)
                 ms = (_time.perf_counter() - t0) / reps * 1e3
             stage_ms[w] = round(ms, 3)
+            if obs.profile.enabled():
+                # static XLA estimate for the already-compiled probe (a
+                # compile-cache hit): measured ms + estimated FLOPs =
+                # achieved compute per stage width
+                cost = obs.profile.cost_of(fn, self.binned, leaf, ghk,
+                                           pend)
+                if cost is not None:
+                    stage_cost[w] = cost
+                    if cost.get("flops"):
+                        obs.set_gauge(f"grow.stage.w{w}_gflops",
+                                      round(cost["flops"] / 1e9, 3))
             obs.observe(f"grow.stage.w{w}", ms / 1e3)
             obs.set_gauge(f"grow.stage.w{w}_ms", round(ms, 3))
             if w == progs.wave_width:
@@ -1709,7 +1723,8 @@ class DeviceGrower:
                 # the plan is now measurement-confirmed (keeps the
                 # early-exit above from re-probing this signature)
                 progs.plan_source = "profiled"
-        return {"stage_ms": stage_ms, "fixed_ms": round(fixed, 3),
+        return {"stage_ms": stage_ms, "stage_cost": stage_cost,
+                "fixed_ms": round(fixed, 3),
                 "col_ms": round(col, 5), "plan": plan,
                 "plan_digest": stage_plan_mod.plan_digest(plan),
                 "installed": installed}
@@ -1746,7 +1761,15 @@ class DeviceGrower:
         ms = (_time.perf_counter() - t0) / max(1, int(reps)) * 1e3
         obs.observe("shard.psum", ms / 1e3)
         obs.set_gauge("shard.psum_ms", round(ms, 3))
-        return {"psum_ms": round(ms, 3)}
+        out = {"psum_ms": round(ms, 3)}
+        if obs.profile.enabled():
+            cost = obs.profile.cost_of(fn, buf)
+            if cost is not None:
+                out["cost"] = cost
+                if cost.get("bytes_accessed"):
+                    obs.set_gauge("shard.psum_gbytes",
+                                  round(cost["bytes_accessed"] / 1e9, 4))
+        return out
 
     # ------------------------------------------------------------------
     def profile_phases(self, grad, hess, reps: int = 20) -> dict:
@@ -1877,6 +1900,28 @@ class DeviceGrower:
         out["dispatch_floor"] = floor
         for name, ms in out.items():
             obs.set_gauge(f"profile.{name}_ms", ms)
+        if obs.profile.enabled():
+            # static XLA estimates for the (already compiled) phase
+            # probes; nested under "costs" so {phase: ms} consumers are
+            # unaffected
+            probe_args = {
+                "wave_hist": (p_hist, (self.binned, leaf_id, grad, hess,
+                                       pending)),
+                "find_best": (p_find, (hists, mask)),
+                "split_apply": (p_apply, (self.binned_t, leaf_id, grp,
+                                          thr, rdel)),
+                "score_update": (p_score, (score, leaf_id, vals)),
+            }
+            costs = {}
+            for name, (fn, a) in probe_args.items():
+                cost = obs.profile.cost_of(fn, *a)
+                if cost is not None:
+                    costs[name] = cost
+                    if cost.get("flops"):
+                        obs.set_gauge(f"profile.{name}_gflops",
+                                      round(cost["flops"] / 1e9, 3))
+            if costs:
+                out["costs"] = costs
         return out
 
 

@@ -394,6 +394,25 @@ PARAM_SCHEMA: Sequence[Param] = (
             "path at the end of train() (implies metrics_enabled). Open at "
             "https://ui.perfetto.dev. Env override: LGBM_TPU_TRACE=<path>",
        section="io"),
+    _p("trace_context_enabled", bool, False, ("trace_context",),
+       desc="causal trace-context propagation (obs/tracing.py, implies "
+            "metrics_enabled): spans gain trace_id/span_id/parent_id and "
+            "the ids flow across thread boundaries — pipeline prep "
+            "thread -> train -> hot-swap -> the serve requests answered "
+            "by that model, micro-batch submit -> worker flush, fleet "
+            "replica dispatch, checkpoint -> resume — so one exported "
+            "trace shows a request's causal chain back to the training "
+            "window that produced its model (docs/Observability.md "
+            "\"Tracing & attribution\"). Off: zero context objects are "
+            "allocated. Env override: LGBM_TPU_TRACE_CTX=1",
+       section="io"),
+    _p("profile_attribution", bool, False, (),
+       desc="attach XLA cost-analysis estimates (FLOPs / bytes accessed "
+            "per compiled program) to the device profiling probes "
+            "(profile_stage_plan / profile_phases / profile_psum, implies "
+            "metrics_enabled); bench.py --explain turns this on to emit "
+            "the phase-attribution report with achieved-GFLOP/s figures",
+       section="io"),
     _p("pipeline_checkpoint_dir", str, "", (),
        desc="windowed pipeline: directory for per-window fault-tolerance "
             "checkpoints (docs/Robustness.md). After every completed "

@@ -50,6 +50,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import obs
+from ..obs import tracing
 from ..boosting import create_boosting
 from ..boosting.gbdt import GBDT
 from ..config import Config
@@ -255,6 +256,11 @@ class RetrainPipeline:
             checkpoint_dir if checkpoint_dir is not None
             else getattr(cfg, "pipeline_checkpoint_dir", "") or "") or None
         self._start_window = 0
+        # causal chain id for this pipeline's windows (obs/tracing.py):
+        # minted lazily at the first traced run(), restored from the
+        # checkpoint manifest on resume() so a resumed window keeps the
+        # originating trace
+        self._trace_id: Optional[str] = None
         self._prev: Optional[GBDT] = None
         self._warmed = False
         self._policy_fallback_logged = False
@@ -287,6 +293,9 @@ class RetrainPipeline:
                 cp.bins_path, rebin_on_drift=pipe.bins.rebin_on_drift)
             loaded.drift_threshold = pipe.bins.drift_threshold
             pipe.bins = loaded
+        # checkpoint -> resume propagation: the resumed windows join the
+        # originating run's causal chain instead of minting a new one
+        pipe._trace_id = cp.trace_id
         model_str = cp.model_string()
         if model_str:
             pipe._prev = GBDT.load_model_from_string(
@@ -310,7 +319,8 @@ class RetrainPipeline:
             bins=self.bins,
             meta={"policy": policy, "rows": int(rows),
                   "num_trees": len(bst.models),
-                  "num_iterations": self.num_iterations})
+                  "num_iterations": self.num_iterations,
+                  "trace_id": self._trace_id})
         obs.observe("pipeline.checkpoint", time.perf_counter() - t0)
         obs.inc("pipeline.checkpoints")
 
@@ -326,15 +336,23 @@ class RetrainPipeline:
             ds, info = self.bins.dataset_for(
                 self.config, dense=pw.dense, csr=pw.csr,
                 categorical=self.categorical, label=pw.label)
+            # captured INSIDE the span: the prep_window span becomes the
+            # parent of everything the main thread does with this
+            # window (train -> swap -> the serve requests its model
+            # answers); None while tracing is off
+            prep_ctx = tracing.capture()
         prep_s = time.perf_counter() - t0
         obs.observe("pipeline.prep", prep_s)
-        return pw, ds, info, prep_s
+        return pw, ds, info, prep_s, prep_ctx
 
-    def _window_stream(self, payloads, prep_fn, stop: threading.Event):
-        """Yield ``("window", idx, pw, ds, info, prep_s)`` items, then
-        ``("done",)`` — from a background thread when pipelined (queue
-        depth 1 = double buffering), inline otherwise.  Prep failures
-        travel as ``("error", idx, exc)``."""
+    def _window_stream(self, payloads, prep_fn, stop: threading.Event,
+                       root_ctx=None):
+        """Yield ``("window", idx, pw, ds, info, prep_s, prep_ctx)``
+        items, then ``("done",)`` — from a background thread when
+        pipelined (queue depth 1 = double buffering), inline otherwise.
+        Prep failures travel as ``("error", idx, exc)``.  ``root_ctx``
+        is the pipeline's trace root, activated on the prep thread
+        (threads start with an empty contextvars context)."""
         start = self._start_window
         if not self.pipelined:
             def inline():
@@ -364,6 +382,7 @@ class RetrainPipeline:
 
         def worker():
             idx = -1
+            tracing.set_current(root_ctx)   # thread-local; dies with us
             try:
                 for idx, payload in enumerate(payloads):
                     if stop.is_set():
@@ -593,9 +612,17 @@ class RetrainPipeline:
         faults.configure_from_config(self.config)
         from .. import compile_cache
         compile_cache.configure_from_config(self.config)
+        # one causal chain per pipeline (kept across resume via the
+        # checkpoint manifest); both the prep thread and the main loop
+        # root their spans under it
+        if tracing.enabled() and self._trace_id is None:
+            self._trace_id = tracing.new_id()
+        root_ctx = (tracing.SpanContext(self._trace_id)
+                    if tracing.enabled() else None)
+        root_tok = tracing.set_current(root_ctx)
         results: List[WindowResult] = []
         stop = threading.Event()
-        stream = self._window_stream(payloads, prep_fn, stop)
+        stream = self._window_stream(payloads, prep_fn, stop, root_ctx)
         try:
             while True:
                 t_wait = time.perf_counter()
@@ -607,7 +634,7 @@ class RetrainPipeline:
                     _, idx, exc = item
                     obs.inc("pipeline.prep_errors")
                     raise PipelineError(idx, results, exc)
-                _, idx, pw, ds, info, prep_s = item
+                _, idx, pw, ds, info, prep_s, prep_ctx = item
                 obs.observe("pipeline.stall", stall_s)
                 if idx > 0:
                     self._prep_total_s += prep_s
@@ -616,23 +643,32 @@ class RetrainPipeline:
                         obs.set_gauge(
                             "pipeline.overlap_fraction",
                             self._overlap_s / self._prep_total_s)
-                with obs.span("pipeline.window", cat="pipeline",
-                              window=idx, rows=int(ds.num_data)):
-                    eval_metrics, eval_s = self._eval_window(pw, eval_fn)
-                    policy = self._policy_for(idx, info["rebinned"])
-                    t0 = time.perf_counter()
-                    # the span exit records the pipeline.train timing
-                    with obs.span("pipeline.train", cat="pipeline",
-                                  window=idx, policy=policy):
-                        bst = self._train_window(ds, policy)
-                    t1 = time.perf_counter()
-                    self._emit_feature_telemetry(bst, idx, policy)
-                    swap_s, same = self._swap(bst)
-                    if self.checkpoint_dir:
-                        # commit the completed window AFTER serving has
-                        # it: a crash from here on resumes at idx + 1
-                        self._save_checkpoint(idx, bst, policy,
-                                              int(ds.num_data))
+                # cross-thread handoff: the window span (and everything
+                # under it — train, swap, checkpoint) parents under the
+                # prep thread's prep_window span
+                ctx_tok = tracing.set_current(prep_ctx)
+                try:
+                    with obs.span("pipeline.window", cat="pipeline",
+                                  window=idx, rows=int(ds.num_data)):
+                        eval_metrics, eval_s = self._eval_window(
+                            pw, eval_fn)
+                        policy = self._policy_for(idx, info["rebinned"])
+                        t0 = time.perf_counter()
+                        # the span exit records the pipeline.train timing
+                        with obs.span("pipeline.train", cat="pipeline",
+                                      window=idx, policy=policy):
+                            bst = self._train_window(ds, policy)
+                        t1 = time.perf_counter()
+                        self._emit_feature_telemetry(bst, idx, policy)
+                        swap_s, same = self._swap(bst)
+                        if self.checkpoint_dir:
+                            # commit the completed window AFTER serving
+                            # has it: a crash from here on resumes at
+                            # idx + 1
+                            self._save_checkpoint(idx, bst, policy,
+                                                  int(ds.num_data))
+                finally:
+                    tracing.reset(ctx_tok)
                 res = WindowResult(
                     window=idx, policy=policy,
                     rebinned=info["rebinned"], drift=info["drift"],
@@ -648,6 +684,7 @@ class RetrainPipeline:
                 if not self.keep_boosters:
                     res.booster = None
         finally:
+            tracing.reset(root_tok)
             stop.set()
             self._shutdown_prep()
         return results

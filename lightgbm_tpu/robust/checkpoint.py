@@ -156,6 +156,13 @@ class PipelineCheckpoint:
     bins_path: Optional[str]
     meta: dict = field(default_factory=dict)
 
+    @property
+    def trace_id(self) -> Optional[str]:
+        """The originating run's causal trace id (obs/tracing.py), when
+        the checkpointing pipeline recorded one — a resumed pipeline
+        reuses it so the resumed windows stay on the same trace."""
+        return str(self.meta.get("trace_id") or "") or None
+
     def model_string(self) -> Optional[str]:
         if self.model_path is None:
             return None

@@ -50,6 +50,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import obs
+from ..obs import tracing
 from ..robust import faults
 from ..robust.retry import CircuitBreaker
 from ..utils.log import LightGBMError, log_warning
@@ -98,7 +99,7 @@ class ModelMeta:
     never drops a request."""
 
     __slots__ = ("objective", "objective_str", "average_output",
-                 "n_iters", "host_trees", "num_model")
+                 "n_iters", "host_trees", "num_model", "train_ctx")
 
     def __init__(self, gbdt, n_iters: int, host_trees=None,
                  num_model: int = 1):
@@ -108,6 +109,10 @@ class ModelMeta:
         self.n_iters = int(n_iters)
         self.host_trees = host_trees
         self.num_model = max(int(num_model), 1)
+        # trace context captured at swap time (obs/tracing.py): when the
+        # swap ran under a pipeline window, every predict span answered
+        # by this generation links back to the window that trained it
+        self.train_ctx = None
 
     def host_raw(self, data: np.ndarray) -> np.ndarray:
         """(K, rows) float64 raw scores via the host tree walk — the
@@ -237,6 +242,9 @@ class PredictionServer:
                     gbdt.models, gbdt.num_model, self.start_iteration,
                     self.num_iteration))
             model = _Model(packed, gbdt, host_trees)
+            # captured inside the serve.swap span: request spans link
+            # through the swap to the training window above it
+            model.train_ctx = tracing.capture()
             with self._lock:
                 prev = self._model
                 self._model = model
@@ -360,7 +368,13 @@ class PredictionServer:
         data = np.atleast_2d(np.asarray(data, np.float64))
         model = self._snapshot()
         with obs.span("serve.predict", cat="serve",
-                      rows=int(data.shape[0])):
+                      rows=int(data.shape[0])) as sp:
+            ctx = model.train_ctx
+            if ctx is not None:
+                # cross-chain link (not a parent edge): the model that
+                # answers this request, back to its training window
+                sp.set(model_trace_id=ctx.trace_id,
+                       model_span_id=ctx.span_id)
             obs.set_gauge("serve.batch_rows", int(data.shape[0]))
             raw = self._score_batch(model, data)
             out = model.convert(raw, raw_score)
@@ -411,8 +425,11 @@ class PredictionServer:
                     or not self._worker.is_alive()):
                 raise LightGBMError("micro-batching worker not running; "
                                     "call start() (or use predict())")
+            # the submitter's trace context rides the queue item (None
+            # while tracing is off): the worker's flush emits a
+            # serve.request span parented under the submit site
             self._queue.put((data, bool(raw_score), fut,
-                             time.perf_counter()))
+                             time.perf_counter(), tracing.capture()))
         return fut
 
     def _drain_loop(self) -> None:
@@ -440,10 +457,10 @@ class PredictionServer:
 
     def _run_batch(self, batch: List[Tuple]) -> None:
         now = time.perf_counter()
-        for _, _, _, t0 in batch:
+        for _, _, _, t0, _ in batch:
             obs.observe("serve.queue_wait", now - t0)
         # one dispatch per raw_score flavor present in the batch
-        for flavor in sorted({rs for _, rs, _, _ in batch}):
+        for flavor in sorted({rs for _, rs, _, _, _ in batch}):
             group = [b for b in batch if b[1] == flavor]
             try:
                 data = np.concatenate([g[0] for g in group], axis=0) \
@@ -475,7 +492,18 @@ class PredictionServer:
                     g[2].set_result(out[lo:hi])
                 lo = hi
         done = time.perf_counter()
-        for _, _, fut, t0 in batch:
+        for data, _, fut, t0, ctx in batch:
             if (fut.done() and not fut.cancelled()
                     and fut.exception() is None):
                 obs.observe("serve.request_latency", done - t0)
+                if ctx is not None:
+                    # submit -> flush causal edge: one span per request
+                    # spanning submit time to future resolution,
+                    # parented under the submitter's active span
+                    obs.span_event(
+                        "serve.request", t0, done - t0, cat="serve",
+                        rows=int(data.shape[0]),
+                        span_id=tracing.new_id(),
+                        trace_id=ctx.trace_id,
+                        **({"parent_id": ctx.span_id}
+                           if ctx.span_id else {}))

@@ -56,6 +56,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
+from ..obs import tracing
 from ..robust import faults
 from ..robust.retry import CircuitBreaker
 from ..utils.log import LightGBMError, log_warning
@@ -560,6 +561,10 @@ class FleetServer:
                 fleets.append(_fleet_write(cur, rrow, idx))
             metas = list(gen.metas)
             metas[m] = self._meta_for(gbdt, pe)
+            # captured inside the serve.fleet.swap span: this tenant's
+            # request spans link through the swap to the training
+            # window above it (obs/tracing.py)
+            metas[m].train_ctx = tracing.capture()
             new_gen = _FleetGen(tuple(fleets), tuple(metas))
             with self._lock:
                 self._gen = new_gen
@@ -751,7 +756,17 @@ class FleetServer:
         rep = (self._replicas[int(replica)] if replica is not None
                else self._pick_replica())
         with obs.span("serve.fleet.predict", cat="serve", rows=n,
-                      replica=rep.index):
+                      replica=rep.index) as sp:
+            if n and tracing.enabled() and int(tid.min()) == \
+                    int(tid.max()):
+                # single-tenant batch: link to the training window of
+                # the one model generation answering it (mixed batches
+                # have no single lineage to name)
+                ctx = gen.metas[int(tid[0])].train_ctx
+                if ctx is not None:
+                    sp.set(tenant=int(tid[0]),
+                           model_trace_id=ctx.trace_id,
+                           model_span_id=ctx.span_id)
             raw = self._score_batch(rep, gen, tid, data)
             out = self._convert(gen, tid, raw, raw_score)
         obs.inc("serve.fleet.requests")
@@ -816,8 +831,10 @@ class FleetServer:
                 raise LightGBMError("fleet micro-batching workers not "
                                     "running; call start() (or "
                                     "predict())")
+            # the submitter's trace context rides the queue item to the
+            # replica worker (None while tracing is off)
             rep.queue.put((tid, data, bool(raw_score), fut,
-                           time.perf_counter()))
+                           time.perf_counter(), tracing.capture()))
         obs.set_gauge(f"serve.fleet.replica_queue_depth.{rep.index}",
                       rep.queue.qsize())
         return fut
@@ -849,9 +866,9 @@ class FleetServer:
 
     def _run_batch(self, rep: _Replica, batch: List[Tuple]) -> None:
         now = time.perf_counter()
-        for _, _, _, _, t0 in batch:
+        for _, _, _, _, t0, _ in batch:
             obs.observe("serve.fleet.queue_wait", now - t0)
-        for flavor in sorted({rs for _, _, rs, _, _ in batch}):
+        for flavor in sorted({rs for _, _, rs, _, _, _ in batch}):
             group = [b for b in batch if b[2] == flavor]
             try:
                 if len(group) > 1:
@@ -883,10 +900,21 @@ class FleetServer:
                     g[3].set_result(out[lo:hi])
                 lo = hi
         done = time.perf_counter()
-        for _, _, _, fut, t0 in batch:
+        for _, data, _, fut, t0, ctx in batch:
             if (fut.done() and not fut.cancelled()
                     and fut.exception() is None):
                 obs.observe("serve.fleet.request_latency", done - t0)
+                if ctx is not None:
+                    # submit -> replica flush causal edge, parented
+                    # under the submitter's active span
+                    obs.span_event(
+                        "serve.fleet.request", t0, done - t0,
+                        cat="serve", rows=int(data.shape[0]),
+                        replica=rep.index,
+                        span_id=tracing.new_id(),
+                        trace_id=ctx.trace_id,
+                        **({"parent_id": ctx.span_id}
+                           if ctx.span_id else {}))
 
 
 class TenantHandle:

@@ -22,18 +22,21 @@ from __future__ import annotations
 
 import gzip
 import os
+import threading
 from typing import Callable, Dict, IO
 
 from .log import LightGBMError
 
 # scheme -> callable(path, mode) -> file object
 _SCHEMES: Dict[str, Callable[[str, str], IO]] = {}
+_schemes_lock = threading.Lock()
 
 
 def register_scheme(scheme: str, opener: Callable[[str, str], IO]) -> None:
     """Plug a filesystem in (the USE_HDFS analog): ``opener(path, mode)``
     receives the FULL path including the scheme prefix."""
-    _SCHEMES[scheme.rstrip(":/")] = opener
+    with _schemes_lock:
+        _SCHEMES[scheme.rstrip(":/")] = opener
 
 
 def _scheme_of(path: str) -> str:

@@ -16,6 +16,9 @@ from typing import Callable, Optional
 _FATAL, _WARNING, _INFO, _DEBUG = -1, 0, 1, 2
 _verbosity = _INFO
 _callback: Optional[Callable[[str], None]] = None
+#: guards the module-level configuration writes below (verbosity,
+#: callback, timer sink) — all reachable from embedder threads
+_state_lock = threading.Lock()
 
 
 class LightGBMError(RuntimeError):
@@ -24,7 +27,8 @@ class LightGBMError(RuntimeError):
 
 def set_verbosity(level: int) -> None:
     global _verbosity
-    _verbosity = level
+    with _state_lock:
+        _verbosity = level
 
 
 def get_verbosity() -> int:
@@ -34,7 +38,8 @@ def get_verbosity() -> int:
 def register_log_callback(cb: Optional[Callable[[str], None]]) -> None:
     """Redirect log output (reference: R callback redirection)."""
     global _callback
-    _callback = cb
+    with _state_lock:
+        _callback = cb
 
 
 def _emit(msg: str) -> None:
@@ -72,7 +77,8 @@ _TIMER_SINK: Optional[Callable[[str, float], None]] = None
 
 def set_timer_sink(sink: Optional[Callable[[str, float], None]]) -> None:
     global _TIMER_SINK
-    _TIMER_SINK = sink
+    with _state_lock:
+        _TIMER_SINK = sink
 
 
 class Timer:

@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""Bench-round regression guard: diff two bench.py result JSONs.
+
+Compares every perf metric the two files share — ``ms_per_tree`` /
+``rows_per_sec`` / speedups / coldstart ratios, including nested ones
+(``legs.int8_pallas.ms_per_tree``, ``mslr.rows_per_sec``, ...) — and
+flags changes worse than the threshold (default 10%) in each metric's
+bad direction.  Accepts both raw ``bench.py`` stdout JSON and the
+committed round wrapper (``BENCH_r*.json``: ``{"parsed": {...}}``).
+
+Usage::
+
+    python scripts/bench_compare.py OLD.json NEW.json
+    python scripts/bench_compare.py --latest          # in-repo rounds:
+        # newest BENCH_r*.json vs the previous parseable one
+    python scripts/bench_compare.py --self-test       # CI sanity
+
+Prints one JSON report line (``regressions`` / ``improvements`` /
+``unchanged`` + the obs digests of both runs when present) and exits
+nonzero iff any metric regressed past the threshold — CI runs
+``--latest`` so a committed round that silently loses >10% on a
+headline metric fails the build instead of being archaeology.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+#: metrics where smaller is better (matched on the LAST path component)
+LOWER_BETTER = {
+    "ms_per_tree", "time_per_tree_ms", "timed_s", "p50_ms", "p95_ms",
+    "p99_ms", "psum_ms", "psum_ms_per_tree", "cold_warmup_compile_s",
+    "warm_warmup_compile_s", "aot_warmup_compile_s",
+}
+#: metrics where bigger is better
+HIGHER_BETTER = {
+    "rows_per_sec", "rows_per_s", "speedup_vs_cpu", "aot_speedup",
+    "shard_scaling_efficiency", "warm_speedup", "rows_per_s_per_model",
+    "coverage",
+}
+#: units that orient the top-level "value" field when its metric name
+#: doesn't already say (s/ms time down = good; x/fraction up = good)
+_VALUE_LOWER_UNITS = ("s", "ms")
+_VALUE_HIGHER_UNITS = ("x", "fraction", "rows/s")
+
+
+def _unwrap(doc: dict) -> dict:
+    """Raw bench.py output passes through; a committed round wrapper
+    contributes its ``parsed`` block (None when the round crashed)."""
+    if "parsed" in doc and "metric" not in doc:
+        return doc["parsed"] or {}
+    return doc
+
+
+def extract_metrics(doc: dict) -> dict:
+    """-> {dotted.path: (value, direction)} for every recognized
+    numeric perf metric, walking nested suite results."""
+    doc = _unwrap(doc)
+    out = {}
+
+    def walk(d, prefix):
+        for k, v in d.items():
+            path = f"{prefix}{k}"
+            if isinstance(v, dict):
+                if k == "obs":   # telemetry digest, not a perf metric
+                    continue
+                walk(v, path + ".")
+                continue
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue
+            if k in LOWER_BETTER:
+                out[path] = (float(v), "lower")
+            elif k in HIGHER_BETTER:
+                out[path] = (float(v), "higher")
+            elif k == "value":
+                unit = str(d.get("unit", ""))
+                if unit in _VALUE_LOWER_UNITS:
+                    out[path] = (float(v), "lower")
+                elif unit in _VALUE_HIGHER_UNITS:
+                    out[path] = (float(v), "higher")
+
+    walk(doc, "")
+    return out
+
+
+def obs_digest(doc: dict) -> dict:
+    """Compact telemetry fingerprint of a run (when the round carried
+    one): recompile totals and iteration percentiles explain WHY a
+    number moved (e.g. a regression with jit_compiles_total up is a
+    retrace bug, not a kernel slowdown)."""
+    obs = _unwrap(doc).get("obs") or {}
+    return {k: obs[k] for k in ("jit_compiles_total", "iter_p50_ms",
+                                "iter_p95_ms", "events_recorded")
+            if k in obs}
+
+
+def compare(old: dict, new: dict, threshold: float) -> dict:
+    om, nm = extract_metrics(old), extract_metrics(new)
+    regressions, improvements, unchanged = [], [], []
+    for path in sorted(set(om) & set(nm)):
+        ov, direction = om[path]
+        nv = nm[path][0]
+        if ov == 0:
+            continue
+        # delta > 0 always means "got worse"
+        delta = (nv - ov) / abs(ov) if direction == "lower" \
+            else (ov - nv) / abs(ov)
+        entry = {"metric": path, "old": ov, "new": nv,
+                 "worse_by": round(delta, 4), "direction": direction}
+        if delta > threshold:
+            regressions.append(entry)
+        elif delta < -threshold:
+            improvements.append(entry)
+        else:
+            unchanged.append(path)
+    return {
+        "threshold": threshold,
+        "compared": len(set(om) & set(nm)),
+        "regressions": regressions,
+        "improvements": improvements,
+        "unchanged": unchanged,
+        "obs_old": obs_digest(old),
+        "obs_new": obs_digest(new),
+    }
+
+
+def _round_key(path: str):
+    m = re.search(r"_r(\d+)", os.path.basename(path))
+    return (int(m.group(1)) if m else -1, path)
+
+
+def latest_pair(pattern: str):
+    """The newest round file vs the previous PARSEABLE one (rounds
+    whose ``parsed`` is null — crashed runs — can't anchor a diff)."""
+    paths = sorted(glob.glob(pattern), key=_round_key)
+    usable = [p for p in paths
+              if extract_metrics(json.load(open(p)))]
+    if len(usable) < 2:
+        return None
+    return usable[-2], usable[-1]
+
+
+def self_test() -> int:
+    base = {"metric": "m", "value": 100.0, "unit": "s",
+            "ms_per_tree": 50.0, "rows_per_sec": 1000.0,
+            "legs": {"f32": {"ms_per_tree": 80.0}},
+            "obs": {"jit_compiles_total": 3}}
+    worse = json.loads(json.dumps(base))
+    worse["ms_per_tree"] = 60.0          # +20%: regression
+    worse["rows_per_sec"] = 1050.0       # +5%: within threshold
+    worse["legs"]["f32"]["ms_per_tree"] = 70.0   # -12.5%: improvement
+    rep = compare(base, worse, 0.10)
+    assert [r["metric"] for r in rep["regressions"]] == ["ms_per_tree"], rep
+    assert [r["metric"] for r in rep["improvements"]] \
+        == ["legs.f32.ms_per_tree"], rep
+    assert "rows_per_sec" in rep["unchanged"], rep
+    assert rep["obs_old"] == {"jit_compiles_total": 3}
+    # wrapper form + direction of higher-better metrics
+    old = {"parsed": {"metric": "m", "value": 5.0, "unit": "x"}}
+    new = {"parsed": {"metric": "m", "value": 4.0, "unit": "x"}}
+    rep = compare(old, new, 0.10)
+    assert [r["metric"] for r in rep["regressions"]] == ["value"], rep
+    # crashed rounds (parsed: null) expose no metrics
+    assert extract_metrics({"parsed": None, "rc": 1}) == {}
+    print("bench_compare self-test OK")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("files", nargs="*",
+                    help="OLD.json NEW.json (bench.py output or "
+                         "committed BENCH_r*.json round wrappers)")
+    ap.add_argument("--latest", action="store_true",
+                    help="compare the two newest parseable rounds "
+                         "matching --glob in the repo root")
+    ap.add_argument("--glob", default="BENCH_r*.json",
+                    help="round pattern for --latest")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="relative worsening that counts as a "
+                         "regression (default 0.10 = 10%%)")
+    ap.add_argument("--self-test", action="store_true")
+    args = ap.parse_args()
+    if args.self_test:
+        return self_test()
+    if args.latest:
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        pair = latest_pair(os.path.join(here, args.glob))
+        if pair is None:
+            print(json.dumps({"skipped": "fewer than two parseable "
+                                         "rounds", "glob": args.glob}))
+            return 0
+        old_path, new_path = pair
+    elif len(args.files) == 2:
+        old_path, new_path = args.files
+    else:
+        ap.error("need OLD.json NEW.json, --latest, or --self-test")
+    with open(old_path) as fh:
+        old = json.load(fh)
+    with open(new_path) as fh:
+        new = json.load(fh)
+    report = compare(old, new, args.threshold)
+    report["old_file"] = os.path.basename(old_path)
+    report["new_file"] = os.path.basename(new_path)
+    print(json.dumps(report))
+    return 1 if report["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

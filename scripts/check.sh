@@ -57,22 +57,30 @@ fi
 
 # 2b. jaxlint with NO baseline over the modules that are debt-free
 #     today (stage-plan, the sharding layer, the whole serve/,
-#     pipeline/, robust/ AND — since the PR-13 ratchet registered its
-#     11 shard_map jits and fixed the global_sum recompile hazard —
-#     parallel/): unlike step 2 — where a new finding in a file with
+#     pipeline/, robust/, obs/, parallel/ AND — since the final
+#     JL006 ratchet lock-guarded the log/file_io module-state writes —
+#     utils/): unlike step 2 — where a new finding in a file with
 #     baselined siblings still fails but the file's debt can only
 #     ratchet down — this step pins an absolute zero-findings contract
-#     for the listed files (only the 4 utils JL006 entries remain
-#     baselined repo-wide)
+#     for the listed files (the repo-wide baseline is now EMPTY: any
+#     new finding anywhere fails step 2)
 step "jaxlint (zero-debt modules)" python -m lightgbm_tpu.tools.jaxlint \
     lightgbm_tpu/ops/stage_plan.py lightgbm_tpu/ops/hist_pallas.py \
     lightgbm_tpu/ops/shard.py lightgbm_tpu/parallel lightgbm_tpu/serve \
     lightgbm_tpu/pipeline lightgbm_tpu/robust lightgbm_tpu/obs \
-    --no-baseline
+    lightgbm_tpu/utils --no-baseline
 
 # 3. the telemetry schema validator validates itself
 step "validate_metrics --self-test" \
     python scripts/validate_metrics.py --self-test
+
+# 3b. bench-round regression guard: self-test, then diff the two
+#     newest committed BENCH_r*.json rounds — a round that silently
+#     lost >10% on a headline metric fails here, not in archaeology
+step "bench_compare --self-test" \
+    python scripts/bench_compare.py --self-test
+step "bench_compare (committed rounds)" \
+    python scripts/bench_compare.py --latest
 
 # 4. docs/Parameters.md regenerates identically from the param schema
 step "docs freshness" python scripts/check_docs_params.py
@@ -105,6 +113,15 @@ if [[ "${1:-}" != "--fast" ]]; then
     #      validate; the disabled hot path stays a single flag check
     #      (docs/Observability.md "Streaming & SLOs")
     step "obs smoke" python scripts/check_obs.py
+
+    # 5b4. trace smoke: a 2-window pipeline + serve round-trip with
+    #      trace_context on — the serve.predict span's model link must
+    #      walk swap -> window -> prep -> root on ONE trace_id, the
+    #      submit->flush edge must parent to the caller, the export
+    #      must pass --trace link validation with named thread lanes,
+    #      and the disabled path must stay the no-op singleton
+    #      (docs/Observability.md "Tracing & attribution")
+    step "trace smoke" python scripts/check_trace.py
 
     # 5c. chaos smoke: a mid-stream kill (injected prep fault) resumes
     #     from the per-window checkpoint to a byte-identical final

@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""CI smoke for causal trace propagation (docs/Observability.md
+"Tracing & attribution").
+
+Runs two synthetic windows through the async retrain pipeline with
+``trace_context`` on, serves requests against the swapped-in model
+(both the synchronous ``predict`` path and the micro-batch
+``submit``/flush path), then asserts the causal chain the tracing
+layer exists for:
+
+1. **One trace**: every span the run records carries the pipeline's
+   single root trace_id — across the prep worker thread, the training
+   window, the hot-swap and the serve calls.
+2. **Serve -> training-window ancestry**: the ``serve.predict`` span's
+   ``model_span_id`` link resolves to the ``serve.swap`` span that
+   installed the model, and the parent chain from that swap walks
+   ``pipeline.window`` -> ``pipeline.prep_window`` -> the trace root —
+   i.e. a served request is attributable to the exact training window
+   that produced its model.
+3. **Submit -> flush**: the ``serve.request`` span event emitted by
+   the worker thread parents back to the submitting caller's span.
+4. **Link integrity + readable lanes**: the Chrome export passes
+   ``validate_metrics.py --trace`` rules (unique span_ids, no orphan
+   parent_ids) and names every thread lane.
+5. **Disabled hot path**: with obs off, ``span()`` hands back the
+   shared no-op singleton and ``tracing.capture()`` is None — zero
+   context objects allocated.
+
+Exit 0 on success, 1 with diagnostics on failure.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+WINDOW_ROWS = 4000
+FEATURES = 8
+WINDOWS = 2
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+          "min_data_in_leaf": 5, "verbosity": -1, "metric": "none",
+          "device_growth": "on", "num_iterations": 4,
+          "trace_context_enabled": True}
+
+
+def main() -> int:
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.obs import tracing
+    from lightgbm_tpu.obs.state import STATE
+    from lightgbm_tpu.pipeline import PreppedWindow, RetrainPipeline
+
+    failures = []
+
+    # --- 5. disabled hot path first, before anything enables obs
+    obs.configure(enabled=False)
+    if obs.span("a", cat="x") is not obs.span("b", cat="y"):
+        failures.append("disabled span() is not the shared singleton")
+    if tracing.capture() is not None:
+        failures.append("disabled tracing.capture() allocated a context")
+
+    obs.configure(enabled=True, trace_context=True)
+
+    def prep(w):
+        rng = np.random.default_rng(1000 + w)
+        x = rng.standard_normal((WINDOW_ROWS, FEATURES))
+        y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float64)
+        return PreppedWindow(label=y, dense=x, eval_dense=x,
+                             eval_label=y)
+
+    pipe = RetrainPipeline(PARAMS, chunk=2)
+    rows = np.zeros((64, FEATURES))
+    pipe.run(range(WINDOWS), prep)
+
+    # serve against the last swapped model: sync + micro-batch paths,
+    # under a caller-side request span (what an embedding service
+    # holds when it calls in — the submit->flush edge parents to it)
+    with obs.span("smoke.request", cat="serve"):
+        pipe.server.predict(rows)
+        pipe.server.start()
+        try:
+            pipe.server.submit(rows).result(timeout=30)
+        finally:
+            pipe.server.stop()
+
+    with STATE.trace._lock:
+        events = list(STATE.trace._events)
+    spans = {}
+    by_name = {}
+    for ev in events:
+        args = ev.args or {}
+        sid = args.get("span_id")
+        if sid:
+            spans[sid] = (ev.name, args)
+        by_name.setdefault(ev.name, []).append(args)
+
+    # --- 1. one trace across the whole pipeline run: every span the
+    # retrain loop records — prep thread, window, train, swap — shares
+    # the root trace_id.  (Serve calls arriving AFTER the run mint
+    # their own request traces; they join causally via the model link.)
+    pipeline_traces = {a.get("trace_id") for name, a in spans.values()
+                       if name.startswith("pipeline.")
+                       or name in ("serve.swap", "flush_pending")}
+    if len(pipeline_traces) != 1:
+        failures.append(f"expected ONE pipeline trace_id, saw "
+                        f"{pipeline_traces}")
+    root_trace = next(iter(pipeline_traces), None)
+
+    # --- 2. serve.predict -> swap -> window -> prep -> root
+    preds = [a for a in by_name.get("serve.predict", [])
+             if a.get("model_span_id")]
+    if not preds:
+        failures.append("no serve.predict span carries a model link")
+    else:
+        link = preds[-1]
+        if link.get("model_trace_id") != root_trace:
+            failures.append(
+                f"serve.predict model_trace_id "
+                f"{link.get('model_trace_id')} != root {root_trace}")
+        chain, cur = [], link["model_span_id"]
+        while cur is not None and cur in spans and len(chain) < 20:
+            name, args = spans[cur]
+            chain.append(name)
+            cur = args.get("parent_id")
+        if chain[:1] != ["serve.swap"]:
+            failures.append(f"model link resolves to {chain[:1]}, "
+                            f"not the serve.swap span")
+        if "pipeline.window" not in chain \
+                or "pipeline.prep_window" not in chain:
+            failures.append(
+                f"serve span ancestry never reaches the training "
+                f"window (chain: {' -> '.join(chain)})")
+        if cur is not None:
+            failures.append(f"ancestry chain broke at unknown span "
+                            f"{cur} (chain: {chain})")
+
+    # --- 3. submit -> worker flush
+    reqs = by_name.get("serve.request", [])
+    if not reqs:
+        failures.append("no serve.request span event from the worker")
+    elif not any(r.get("parent_id") in spans for r in reqs):
+        failures.append(f"serve.request parent_id does not resolve "
+                        f"to a recorded span ({reqs[-1]})")
+
+    # --- 4. exported chrome trace: validator rules + named lanes
+    with tempfile.TemporaryDirectory() as td:
+        trace_path = os.path.join(td, "trace.json")
+        obs.dump_trace(trace_path)
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "validate_metrics.py"),
+             "--trace", trace_path],
+            capture_output=True, text=True)
+        if proc.returncode != 0:
+            failures.append(f"validate_metrics --trace rejected the "
+                            f"exported trace: {proc.stderr.strip()}")
+        with open(trace_path) as fh:
+            chrome = json.load(fh)
+    evs = chrome["traceEvents"]
+    tids = {e["tid"] for e in evs if e.get("ph") == "X"}
+    named = {e["tid"] for e in evs
+             if e.get("ph") == "M" and e.get("name") == "thread_name"
+             and e.get("args", {}).get("name")}
+    if not tids <= named:
+        failures.append(f"unnamed thread lanes: {tids - named}")
+    if len(tids) < 2:
+        failures.append(f"expected spans from >=2 threads (prep worker "
+                        f"+ main), saw tids {tids}")
+
+    summary = {
+        "events": len(events),
+        "spans": len(spans),
+        "trace_id": root_trace,
+        "serve_requests": len(reqs),
+        "threads": len(tids),
+    }
+    print(json.dumps(summary))
+    if failures:
+        for f in failures:
+            print(f"TRACE SMOKE FAIL: {f}", file=sys.stderr)
+        return 1
+    print("trace smoke PASS: serve span ancestry reaches the training "
+          "window on one trace_id; disabled path stays no-op")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,6 +6,7 @@ Usage::
     python scripts/validate_metrics.py metrics.json     # snapshot doc
     python scripts/validate_metrics.py --stream s.jsonl # exporter stream
     python scripts/validate_metrics.py --prom m.prom    # exposition file
+    python scripts/validate_metrics.py --trace t.json   # span links
 
 Exit 0 when the document is schema-valid, 1 with one error per line
 otherwise.  Also importable: ``validate(doc)`` /
@@ -25,7 +26,7 @@ from __future__ import annotations
 import json
 import re
 import sys
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 SCHEMA_NAME = "lightgbm-tpu-metrics"
 SCHEMA_VERSION = 2
@@ -327,6 +328,89 @@ def validate_prometheus(text: str) -> List[str]:
     return errors
 
 
+def validate_trace(doc) -> List[str]:
+    """Span-link integrity for an exported trace (``--trace``).
+
+    Accepts the Chrome-trace object (``obs.dump_trace``) or a plain
+    list of event dicts (parsed ``dump_events_jsonl`` lines).  With
+    ``trace_context`` on, span events carry ``trace_id``/``span_id``/
+    ``parent_id`` in ``args``; the rules:
+
+    * span_ids are unique and always accompanied by a trace_id;
+    * every ``parent_id`` resolves to a recorded span (no orphans) and
+      parent/child agree on trace_id;
+    * parent chains terminate (no cycles);
+    * cross-chain links (a serve span's ``model_span_id``) that resolve
+      in-buffer must agree on ``model_trace_id`` — an unresolved link
+      is NOT an error (the training span may predate a trace reset).
+    """
+    if isinstance(doc, dict):
+        events = doc.get("traceEvents")
+        if not isinstance(events, list):
+            return ["chrome trace missing traceEvents array"]
+    elif isinstance(doc, list):
+        events = doc
+    else:
+        return ["trace document is neither a chrome-trace object nor "
+                "an event list"]
+    errors: List[str] = []
+    err = errors.append
+    spans: Dict[str, tuple] = {}   # span_id -> (name, trace_id, parent)
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            err(f"event {i} is not an object")
+            continue
+        args = ev.get("args")
+        if not isinstance(args, dict):
+            continue
+        sid = args.get("span_id")
+        if sid is None:
+            continue
+        name = ev.get("name", "?")
+        trace = args.get("trace_id")
+        if not trace:
+            err(f"span {name!r} carries span_id {sid} but no trace_id")
+        if sid in spans:
+            err(f"duplicate span_id {sid} ({spans[sid][0]!r} and "
+                f"{name!r})")
+            continue
+        spans[sid] = (name, trace, args.get("parent_id"))
+    for sid, (name, trace, parent) in spans.items():
+        if parent is None:
+            continue
+        if parent not in spans:
+            err(f"orphan parent_id {parent} on span {name!r} ({sid})")
+            continue
+        ptrace = spans[parent][1]
+        if trace and ptrace and trace != ptrace:
+            err(f"span {name!r} trace_id {trace} != parent "
+                f"{spans[parent][0]!r} trace_id {ptrace}")
+    for sid in spans:
+        seen = set()
+        cur: Optional[str] = sid
+        while cur is not None and cur in spans:
+            if cur in seen:
+                err(f"parent cycle reachable from span_id {sid}")
+                break
+            seen.add(cur)
+            cur = spans[cur][2]
+    for ev in events:
+        if not isinstance(ev, dict):
+            continue
+        args = ev.get("args")
+        if not isinstance(args, dict):
+            continue
+        link = args.get("model_span_id")
+        if link and link in spans:
+            want = args.get("model_trace_id")
+            have = spans[link][1]
+            if want and have and want != have:
+                err(f"span {ev.get('name')!r} model_trace_id {want} "
+                    f"!= linked span {spans[link][0]!r} trace_id "
+                    f"{have}")
+    return errors
+
+
 def validate_training_run(doc: Dict) -> List[str]:
     """Beyond schema shape: assertions a real (enabled) training run
     must satisfy — per-phase/iteration timings present, at least one
@@ -456,6 +540,48 @@ _SELF_TEST_CASES = [
     ("slo non-bool ok", ("slo", "ok"), "yes", "slo.ok"),
 ]
 
+def _good_trace() -> Dict:
+    """A chrome trace with one causal chain (root -> window -> swap)
+    plus a serve span linking back to the swap."""
+    def span(name, sid, trace="t1", parent=None, **extra):
+        args = {"trace_id": trace, "span_id": sid, **extra}
+        if parent:
+            args["parent_id"] = parent
+        return {"name": name, "cat": "x", "ph": "X", "pid": 0,
+                "tid": 1, "ts": 0.0, "dur": 1.0, "args": args}
+    return {"traceEvents": [
+        {"name": "process_name", "ph": "M", "pid": 0, "tid": 0,
+         "args": {"name": "lightgbm_tpu"}},
+        span("pipeline.prep_window", "s1"),
+        span("pipeline.window", "s2", parent="s1"),
+        span("serve.swap", "s3", parent="s2"),
+        span("serve.predict", "s4", model_trace_id="t1",
+             model_span_id="s3"),
+    ]}
+
+
+#: (description, mutator(trace dict), substring the error must carry)
+_TRACE_SELF_TEST_CASES = [
+    ("orphan parent_id",
+     lambda t: t["traceEvents"][2]["args"].update(parent_id="nope"),
+     "orphan parent_id"),
+    ("duplicate span_id",
+     lambda t: t["traceEvents"][4]["args"].update(span_id="s1"),
+     "duplicate span_id"),
+    ("span_id without trace_id",
+     lambda t: t["traceEvents"][2]["args"].pop("trace_id"),
+     "no trace_id"),
+    ("parent trace mismatch",
+     lambda t: t["traceEvents"][3]["args"].update(trace_id="t2"),
+     "trace_id"),
+    ("model link trace mismatch",
+     lambda t: t["traceEvents"][4]["args"].update(model_trace_id="t9"),
+     "model_trace_id"),
+    ("parent cycle",
+     lambda t: t["traceEvents"][1]["args"].update(parent_id="s3"),
+     "cycle"),
+]
+
 #: (description, bad exposition text, substring the error must carry)
 _PROM_SELF_TEST_CASES = [
     ("illegal metric name",
@@ -515,6 +641,30 @@ def self_test() -> int:
                                      counters={"x": {"delta": 1}})):
         failures.append("null-window stream line with counters not "
                         "caught")
+    errs = validate_trace(_good_trace())
+    if errs:
+        failures.append(f"good trace rejected: {errs}")
+    # spans with no trace context (trace_context off) validate clean,
+    # and an unresolved model link is legitimately not an error
+    bare = {"traceEvents": [{"name": "x", "ph": "X", "pid": 0,
+                             "tid": 1, "ts": 0.0, "dur": 1.0},
+                            {"name": "serve.predict", "ph": "X",
+                             "pid": 0, "tid": 1, "ts": 0.0, "dur": 1.0,
+                             "args": {"model_span_id": "gone",
+                                      "model_trace_id": "t0"}}]}
+    errs = validate_trace(bare)
+    if errs:
+        failures.append(f"context-free trace rejected: {errs}")
+    for desc, mutate, needle in _TRACE_SELF_TEST_CASES:
+        t = _good_trace()
+        mutate(t)
+        errs = validate_trace(t)
+        if not errs:
+            failures.append(f"planted trace defect not caught: {desc}")
+        elif not any(needle in e for e in errs):
+            failures.append(
+                f"planted trace defect {desc!r} caught with unexpected "
+                f"message(s): {errs}")
     errs = validate_prometheus(_GOOD_PROM)
     if errs:
         failures.append(f"good exposition rejected: {errs}")
@@ -531,7 +681,8 @@ def self_test() -> int:
         for f in failures:
             print(f"SELF-TEST FAIL: {f}", file=sys.stderr)
         return 1
-    n = len(_SELF_TEST_CASES) + len(_PROM_SELF_TEST_CASES) + 8
+    n = (len(_SELF_TEST_CASES) + len(_PROM_SELF_TEST_CASES)
+         + len(_TRACE_SELF_TEST_CASES) + 10)
     print(f"OK: validator self-test passed ({n} cases)")
     return 0
 
@@ -546,6 +697,22 @@ def main(argv=None) -> int:
             print(f"INVALID: {e}", file=sys.stderr)
         if not errors:
             print(f"OK: {argv[1]} is valid Prometheus exposition")
+        return 1 if errors else 0
+    if len(argv) == 2 and argv[0] == "--trace":
+        with open(argv[1]) as fh:
+            head = fh.read(1)
+            fh.seek(0)
+            if head == "{":
+                doc = json.load(fh)
+                n_ev = len(doc.get("traceEvents", []))
+            else:
+                doc = [json.loads(line) for line in fh if line.strip()]
+                n_ev = len(doc)
+        errors = validate_trace(doc)
+        for e in errors:
+            print(f"INVALID: {e}", file=sys.stderr)
+        if not errors:
+            print(f"OK: {argv[1]} span links intact ({n_ev} events)")
         return 1 if errors else 0
     if len(argv) == 2 and argv[0] == "--stream":
         errors = []
