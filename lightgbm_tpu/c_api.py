@@ -21,6 +21,8 @@ code ports mechanically:
 Functions intentionally keep the reference's argument order, including
 the ``parameters`` string argument, so a port is a transliteration.
 """
+# jaxlint: abi-header=../include/lightgbm_tpu/c_api.h
+# (JL151 checks every declaration below against these defs' arities)
 
 from __future__ import annotations
 

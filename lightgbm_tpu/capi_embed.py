@@ -19,6 +19,11 @@ windowed harness gets per-window retrain spans, recompile counts and
 memory peaks by exporting two env vars, no C++ change.  Each
 ``booster_create`` marks a retrain window boundary.
 """
+# jaxlint: abi-header=../include/lightgbm_tpu/c_api.h
+# jaxlint: abi-impl=../src/capi/lgbm_capi.cpp
+# (JL151 cross-checks header<->cpp parity, every call_adapter name and
+# Py_BuildValue format against the adapters below, and each forwarded
+# _call(C.LGBM_*, ...) against the header's arity and parameter order)
 
 from __future__ import annotations
 

@@ -77,12 +77,31 @@ def _chunk_reader(path: str,
             return
         put(None)
 
+    def next_item():
+        # timed get + liveness check: a reader killed mid-chunk (OOM,
+        # interpreter teardown) must surface as an error, not hang the
+        # consumer forever on an empty queue
+        while True:
+            try:
+                return q.get(timeout=0.5)
+            except queue.Empty:
+                if t.is_alive():
+                    continue
+                try:
+                    # the reader may have delivered its last item (or
+                    # sentinel) between the timeout and the death check
+                    return q.get_nowait()
+                except queue.Empty:
+                    raise LightGBMError(
+                        f"stream reader thread for {path} died "
+                        "without delivering a result") from None
+
     t = threading.Thread(target=reader, daemon=True,
                          name="lgbm-stream-reader")
     t.start()
     try:
         while True:
-            item = q.get()
+            item = next_item()
             if item is None:
                 break
             if isinstance(item, LightGBMError):

@@ -273,12 +273,16 @@ class StreamExporter:
     # -- hot-path-safe handoff -------------------------------------------
     def emit(self, now: Optional[float] = None) -> bool:
         """Snapshot and offer to the writer queue — NON-BLOCKING.  A
-        full queue drops the snapshot (counted), it never waits."""
-        doc = self.collect(now)
+        full queue drops the snapshot (counted), it never waits.
+        Chaos-armed at ``obs.export``: an injected fault here behaves
+        exactly like a full queue (dropped + counted, never raised)."""
+        from ..robust import faults
         try:
+            faults.check("obs.export")
+            doc = self.collect(now)
             self._queue.put_nowait(doc)
             return True
-        except _queue.Full:
+        except (_queue.Full, faults.InjectedFault):
             with self._lock:
                 self._dropped += 1
             _inc("export.dropped")
@@ -312,7 +316,11 @@ class StreamExporter:
             self._write_locked(doc)
 
     def _write_locked(self, doc: Dict) -> None:
+        from ..robust import faults
         try:
+            # chaos-armed: an injected fault on the writer thread takes
+            # the same path as a real disk failure (counted, not raised)
+            faults.check("obs.export")
             if self.stream_path:
                 with open(self.stream_path, "a") as fh:
                     fh.write(json.dumps(doc) + "\n")

@@ -405,8 +405,26 @@ class RetrainPipeline:
         self._prep_queue = q
 
         def drain():
+            # timed get + liveness check: a prep thread killed without
+            # running its except/put (interpreter teardown, os._exit in
+            # a prep_fn) must surface as an error on the training
+            # thread, not hang the window loop forever
             while True:
-                yield q.get()
+                try:
+                    yield q.get(timeout=0.5)
+                    continue
+                except queue.Empty:
+                    pass
+                if t.is_alive():
+                    continue
+                try:
+                    # the worker may have delivered its final item
+                    # between the timeout and the death check
+                    yield q.get_nowait()
+                except queue.Empty:
+                    raise LightGBMError(
+                        "pipeline prep thread died without delivering "
+                        "a result") from None
 
         return drain()
 

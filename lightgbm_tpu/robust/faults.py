@@ -15,6 +15,7 @@ Named sites wired in this codebase::
 
     grow.dispatch    DeviceGrower dispatch (per-iteration and fused)
     serve.dispatch   packed-forest device traversal in PredictionServer
+    serve.fleet.dispatch  packed-fleet replica traversal in FleetServer
     pipeline.prep    RetrainPipeline host prep (runs on the prep thread)
     pipeline.train   RetrainPipeline device-training stage
     net.connect      socket connect (parallel/network.py helpers)
@@ -23,6 +24,7 @@ Named sites wired in this codebase::
     io.read          streaming text reader (data/stream_loader.py)
     io.write         atomic checkpoint writes (robust/checkpoint.py)
     stream.parse     chunk parsing in the streaming loader
+    obs.export       telemetry snapshot/write path (obs/export.py)
 
 Spec grammar — comma-separated entries, each ``site[:key=value|flag]*``::
 
@@ -59,13 +61,20 @@ from ..utils.log import LightGBMError, log_warning
 
 ENV_VAR = "LGBM_TPU_FAULTS"
 
-#: sites production code is instrumented with (typo guard at configure)
+#: sites production code is instrumented with (typo guard at configure;
+#: jaxlint JL161 verifies both directions of this registry statically)
 KNOWN_SITES = (
     "grow.dispatch", "serve.dispatch", "serve.fleet.dispatch",
     "pipeline.prep", "pipeline.train",
     "net.connect", "net.send", "net.recv", "io.read", "io.write",
-    "stream.parse",
+    "stream.parse", "obs.export",
 )
+
+
+def known_sites() -> tuple:
+    """The instrumented fault sites, for error messages and tooling —
+    the single source the runtime typo guard and JL161 both read."""
+    return KNOWN_SITES
 
 
 class InjectedFault(RuntimeError):
@@ -178,9 +187,9 @@ def parse_fault_spec(spec: str) -> Dict[str, FaultRule]:
             else:
                 raise LightGBMError(
                     f"unknown fault spec key {k!r} in {entry!r}")
-        if site not in KNOWN_SITES:
+        if site not in known_sites():
             log_warning(f"fault spec names unknown site {site!r} "
-                        f"(known: {', '.join(KNOWN_SITES)}); armed "
+                        f"(known: {', '.join(known_sites())}); armed "
                         f"anyway for custom check() sites")
         rules[site] = rule
     return rules

@@ -26,6 +26,8 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import posixpath
+import re
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .context import Finding
@@ -47,6 +49,54 @@ def tree_sha(file_hashes: Sequence[Tuple[str, str]]) -> str:
         h.update(sha.encode())
         h.update(b"\n")
     return h.hexdigest()
+
+
+#: ``# jaxlint: abi-header=...`` / ``abi-impl=...`` directives name
+#: non-Python inputs (C header / .cpp) that project rules read.  Paths
+#: are relative to the *directive-carrying file*, so a fixture corpus
+#: copied elsewhere keeps resolving its own sibling header.
+EXTRA_INPUT_DIRECTIVE_RE = re.compile(
+    r"#\s*jaxlint:\s*abi-(?:header|impl)\s*=\s*(\S+)")
+
+
+def resolve_extra_path(relpath: str, target: str) -> str:
+    """Normalize a directive ``target`` against its declaring file."""
+    return posixpath.normpath(
+        posixpath.join(posixpath.dirname(relpath.replace("\\", "/")),
+                       target))
+
+
+def scan_extra_inputs(sources: Sequence[Tuple[str, str]],
+                      root) -> Dict[str, Optional[str]]:
+    """Collect ``abi-*`` directive targets from ``(relpath, src)`` pairs.
+
+    Returns normalized-relpath -> file text, or ``None`` when the
+    target is missing/unreadable (the rules then stay silent for it,
+    but the sentinel still feeds the tree hash so creating the file
+    later invalidates the project cache).
+    """
+    out: Dict[str, Optional[str]] = {}
+    for rel, src in sources:
+        for m in EXTRA_INPUT_DIRECTIVE_RE.finditer(src):
+            key = resolve_extra_path(rel, m.group(1))
+            if key in out:
+                continue
+            path = (key if os.path.isabs(key)
+                    else os.path.join(str(root), key))
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    out[key] = fh.read()
+            except OSError:
+                out[key] = None
+    return out
+
+
+def extra_input_hashes(extra: Dict[str, Optional[str]]) \
+        -> List[Tuple[str, str]]:
+    """Hash pairs for the tree key: C inputs invalidate like sources."""
+    return [("extra::" + rel,
+             file_sha(text) if text is not None else "<missing>")
+            for rel, text in extra.items()]
 
 
 def tool_hash() -> str:

@@ -18,7 +18,8 @@ import os
 from pathlib import Path
 from typing import Iterable, List, Optional, Sequence, Set, Tuple
 
-from .cache import LintCache, file_sha, tree_sha
+from .cache import (LintCache, extra_input_hashes, file_sha,
+                    scan_extra_inputs, tree_sha)
 from .context import FileContext, Finding
 from .rules import FILE_RULES, PROJECT_RULES
 
@@ -73,12 +74,14 @@ def _run_file_rules(ctx: FileContext, select: Optional[Set[str]],
 def _run_project_rules(contexts: Sequence[FileContext],
                        select: Optional[Set[str]],
                        findings: List[Finding],
-                       suppressed: List[Finding]) -> None:
+                       suppressed: List[Finding],
+                       root: Optional[str] = None,
+                       extra_files=None) -> None:
     from .project import ProjectContext
     if not any(select is None or code in select
                for code in PROJECT_RULES):
         return
-    project = ProjectContext(contexts)
+    project = ProjectContext(contexts, root=root, extra_files=extra_files)
     ctx_by_path = {c.relpath: c for c in contexts}
     for code, rule in PROJECT_RULES.items():
         if select is not None and code not in select:
@@ -143,7 +146,11 @@ def analyze_paths(paths: Sequence[str], root: Optional[str] = None,
 
     cache = LintCache(cache_dir) if cache_dir is not None else None
     hashes = [(rel, file_sha(src)) for rel, src in sources]
-    tree = tree_sha(hashes)
+    # non-Python inputs named by abi-* directives (C header / .cpp)
+    # content-hash into the tree key: a header edit invalidates the
+    # project tier even though no .py file changed
+    extra = scan_extra_inputs(sources, rootp)
+    tree = tree_sha(hashes + extra_input_hashes(extra))
 
     def keep(fs: Iterable[Finding]) -> List[Finding]:
         if select is None:
@@ -201,7 +208,10 @@ def analyze_paths(paths: Sequence[str], root: Optional[str] = None,
         else:
             pf: List[Finding] = []
             ps: List[Finding] = []
-            _run_project_rules(contexts, select, pf, ps)
+            _run_project_rules(
+                contexts, select, pf, ps, root=str(rootp),
+                extra_files={k: v for k, v in extra.items()
+                             if v is not None})
             if cache is not None and select is None \
                     and not result.errors:
                 cache.store_project(tree, pf, ps)

@@ -115,7 +115,16 @@ def module_name_for(relpath: str) -> str:
 class ProjectContext:
     """Cross-module view over a set of :class:`FileContext`s."""
 
-    def __init__(self, contexts: Iterable[FileContext]):
+    def __init__(self, contexts: Iterable[FileContext],
+                 root: Optional[str] = None,
+                 extra_files: Optional[Dict[str, str]] = None):
+        #: analysis root (str path) when the run has one; single-source
+        #: runs (``analyze_source``) leave it ``None`` and rules that
+        #: need sibling non-Python inputs stay silent.
+        self.root = root
+        #: root-relative path -> text for non-Python inputs pulled in by
+        #: ``# jaxlint: abi-*`` directives (C headers, .cpp sources).
+        self.extra_files: Dict[str, str] = dict(extra_files or {})
         self.modules: Dict[str, ModuleInfo] = {}
         self.ctx_for: Dict[str, FileContext] = {}
         for ctx in contexts:

@@ -8,12 +8,13 @@ import-time magic) so the set of shipped rules is grep-able here.
 
 from __future__ import annotations
 
-from . import (determinism, dtype_drift, dtype_flow, global_state,
-               host_sync, jit_registry, lock_order, recompile, set_order,
-               trace_key)
+from . import (abi_parity, concurrency, determinism, dtype_drift,
+               dtype_flow, fault_coverage, global_state, host_sync,
+               jit_registry, lock_order, recompile, set_order, trace_key)
 
 _MODULES = (host_sync, recompile, jit_registry, dtype_drift, set_order,
-            global_state, trace_key, dtype_flow, lock_order, determinism)
+            global_state, trace_key, dtype_flow, lock_order, determinism,
+            concurrency, abi_parity, fault_coverage)
 
 #: code -> rule module, in code order
 RULES = {m.CODE: m for m in _MODULES}

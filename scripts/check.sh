@@ -55,20 +55,22 @@ else
         python scripts/check_jaxlint_cache.py
 fi
 
-# 2b. jaxlint with NO baseline over the modules that are debt-free
-#     today (stage-plan, the sharding layer, the whole serve/,
-#     pipeline/, robust/, obs/, parallel/ AND — since the final
-#     JL006 ratchet lock-guarded the log/file_io module-state writes —
-#     utils/): unlike step 2 — where a new finding in a file with
-#     baselined siblings still fails but the file's debt can only
-#     ratchet down — this step pins an absolute zero-findings contract
-#     for the listed files (the repo-wide baseline is now EMPTY: any
-#     new finding anywhere fails step 2)
-step "jaxlint (zero-debt modules)" python -m lightgbm_tpu.tools.jaxlint \
-    lightgbm_tpu/ops/stage_plan.py lightgbm_tpu/ops/hist_pallas.py \
-    lightgbm_tpu/ops/shard.py lightgbm_tpu/parallel lightgbm_tpu/serve \
-    lightgbm_tpu/pipeline lightgbm_tpu/robust lightgbm_tpu/obs \
-    lightgbm_tpu/utils --no-baseline
+# 2b. jaxlint with NO baseline over the WHOLE package: the repo-wide
+#     baseline ratcheted down to empty, so this pins an absolute
+#     zero-findings contract with no baseline escape hatch (step 2
+#     still runs separately to gate the baseline file itself).  Must
+#     be a full-package scan: JL161's dead-registry-entry check is a
+#     whole-program property — a subset scan that sees
+#     robust/faults.py but not the arming calls in data/ and
+#     boosting/ would report false dead entries
+step "jaxlint (zero-debt, whole package)" python -m \
+    lightgbm_tpu.tools.jaxlint lightgbm_tpu --no-baseline
+
+# 2c. C-ABI smoke: JL151 parity standalone (header <-> cpp <-> bindings
+#     <-> adapter table) plus a grep-level assertion that the native
+#     smoke_test.cpp exercises every Serve*/Fleet*/Warmup* entry point
+#     the header declares — no compiler needed in CI
+step "abi parity + native smoke coverage" python scripts/check_abi.py
 
 # 3. the telemetry schema validator validates itself
 step "validate_metrics --self-test" \

@@ -1,0 +1,71 @@
+"""CI gate: the C ABI is in sync across all four surfaces, and the
+native smoke test exercises the serving/fleet/warmup entry points.
+
+No compiler needed — two grep-level checks on top of jaxlint's JL151
+scanner:
+
+1. a standalone ``--select JL151`` run over the package must report
+   zero findings (header <-> cpp <-> bindings <-> adapter parity);
+2. every ``LGBM_Serve*`` / ``LGBM_Fleet*`` / ``LGBM_Warmup*`` entry
+   point the header declares must appear as a call in
+   ``src/capi/smoke_test.cpp`` — a new serving ABI entry that ships
+   without native smoke coverage fails CI here, not in a user's
+   harness.
+
+Run from the repo root: ``python scripts/check_abi.py``.
+"""
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from lightgbm_tpu.tools.jaxlint.core import analyze_paths  # noqa: E402
+from lightgbm_tpu.tools.jaxlint.rules.abi_parity import _scan_c  # noqa: E402
+
+HEADER = REPO / "include" / "lightgbm_tpu" / "c_api.h"
+SMOKE = REPO / "src" / "capi" / "smoke_test.cpp"
+SMOKE_PREFIXES = ("LGBM_Serve", "LGBM_Fleet", "LGBM_Warmup")
+
+
+def main() -> int:
+    ok = True
+
+    result = analyze_paths([str(REPO / "lightgbm_tpu")], root=str(REPO),
+                           select={"JL151"})
+    for path, msg in result.errors:
+        print(f"check_abi: analyzer error in {path}: {msg}")
+        ok = False
+    for f in result.findings:
+        print(f"check_abi: {f.path}:{f.line}: {f.rule} {f.message}")
+        ok = False
+    if ok:
+        print("check_abi: JL151 parity clean "
+              f"({result.files_scanned} files)")
+
+    decls = _scan_c(HEADER.read_text(encoding="utf-8"), want_defs=False)
+    targets = sorted(n for n in decls if n.startswith(SMOKE_PREFIXES))
+    if not targets:
+        print(f"check_abi: no serving entry points found in {HEADER} "
+              "— scanner or header regression")
+        return 1
+    smoke = SMOKE.read_text(encoding="utf-8")
+    missing = [n for n in targets
+               if not re.search(rf"\b{n}\s*\(", smoke)]
+    for n in missing:
+        print(f"check_abi: header declares `{n}` but "
+              f"{SMOKE.relative_to(REPO)} never calls it — extend the "
+              "native smoke test to cover the new entry point")
+    if missing:
+        ok = False
+    else:
+        print(f"check_abi: smoke_test.cpp exercises all {len(targets)} "
+              "Serve/Fleet/Warmup entry points")
+
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,126 @@
+"""Corpus: JL141 — thread/queue concurrency-graph hazards.
+
+Planted defects: a spawned thread that opens spans with no
+SpanContext handoff, blocking queue ops / a bare acquire in dispatch
+scopes, and a join executed under a lock the joined thread acquires.
+The good twins (context handed off, timeouts everywhere, join after
+release) must stay silent.  The nested workers carry
+``disable=JL161`` because this fixture deliberately has no
+fault-site registry wiring (fault_coverage.py owns that).
+"""
+import queue
+import threading
+
+import obs
+import tracing
+
+
+# -- (a) spans on a spawned thread --------------------------------------
+
+def spawn_without_handoff():
+    def worker():  # jaxlint: disable=JL161
+        with obs.span("corpus.window", cat="corpus"):
+            pass
+
+    t = threading.Thread(target=worker)  # PLANT: JL141
+    t.start()
+    return t
+
+
+def spawn_with_set_current(captured):
+    def worker():  # jaxlint: disable=JL161
+        tracing.set_current(captured)
+        with obs.span("corpus.window", cat="corpus"):
+            pass
+
+    t = threading.Thread(target=worker)  # ok: context activated inside
+    t.start()
+    return t
+
+
+def spawn_with_ctx_param(span_ctx):
+    def worker(ctx):  # jaxlint: disable=JL161
+        with obs.span("corpus.window", cat="corpus"):
+            pass
+
+    t = threading.Thread(target=worker, args=(span_ctx,))  # ok: ctx arg
+    t.start()
+    return t
+
+
+# -- (b) blocking calls in dispatch scopes ------------------------------
+
+def dispatch_blocking():
+    q = queue.Queue(maxsize=4)
+
+    def worker():  # jaxlint: disable=JL161
+        while True:
+            try:
+                if q.get(timeout=0.5) is None:  # ok: timed
+                    return
+            except queue.Empty:
+                continue
+
+    t = threading.Thread(target=worker)
+    t.start()
+    q.put("work")  # PLANT: JL141
+    return q.get()  # PLANT: JL141
+
+
+def dispatch_nonblocking():
+    q = queue.Queue()  # unbounded: puts never block
+
+    def worker():  # jaxlint: disable=JL161
+        try:
+            q.get(timeout=0.1)
+        except queue.Empty:
+            pass
+
+    t = threading.Thread(target=worker)
+    t.start()
+    q.put("work")  # ok: unbounded put
+    try:
+        return q.get(timeout=1.0)  # ok: timed
+    except queue.Empty:
+        return None
+
+
+def dispatch_bare_acquire():
+    lock = threading.Lock()
+
+    def worker():  # jaxlint: disable=JL161
+        with lock:  # ok: context manager
+            pass
+
+    t = threading.Thread(target=worker)
+    t.start()
+    lock.acquire()  # PLANT: JL141
+    try:
+        return t
+    finally:
+        lock.release()
+
+
+# -- (c) join while holding the target's lock ---------------------------
+
+class Flusher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t = None
+
+    def start(self):
+        self._t = threading.Thread(target=self._flush_loop)
+        self._t.start()
+
+    def _flush_loop(self):  # jaxlint: disable=JL161
+        with self._lock:
+            pass
+
+    def stop_deadlocks(self):
+        with self._lock:
+            self._t.join()  # PLANT: JL141
+
+    def stop_ok(self):
+        with self._lock:
+            t = self._t
+        t.join()  # ok: lock released before the join

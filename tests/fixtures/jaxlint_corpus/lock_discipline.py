@@ -49,7 +49,7 @@ class Worker:
         self._progress = 0
         self._thread = threading.Thread(target=self._run)
 
-    def _run(self):
+    def _run(self):  # jaxlint: disable=JL161
         self._progress = 1          # PLANT: JL121
         with self._lock:
             self._results = []

@@ -396,6 +396,100 @@ def test_injected_jl131_wall_clock_in_checkpoint(pkg_copy):
         p.write_text(orig)
 
 
+def test_injected_jl141_dropped_context_handoff(pkg_copy):
+    """Deleting the pipeline worker's ``tracing.set_current(root_ctx)``
+    handoff (the PR-16 causal-chain invariant) must fire JL141 at the
+    worker spawn."""
+    anchor = ("            tracing.set_current(root_ctx)"
+              "   # thread-local; dies with us\n")
+    p, orig = _mutate(pkg_copy, "lightgbm_tpu/pipeline/core.py",
+                      anchor, "")
+    try:
+        r = _lint(pkg_copy, "--select", "JL141", "--no-baseline")
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "JL141" in r.stdout and "SpanContext" in r.stdout
+        assert "pipeline/core.py" in r.stdout
+    finally:
+        p.write_text(orig)
+
+
+def test_injected_jl141_untimed_queue_get(pkg_copy):
+    """Stripping the timeout from the stream loader's consumer-side
+    ``q.get`` — the exact hang this PR's audit fixed — must fire
+    JL141."""
+    p, orig = _mutate(pkg_copy, "lightgbm_tpu/data/stream_loader.py",
+                      "return q.get(timeout=0.5)", "return q.get()")
+    try:
+        r = _lint(pkg_copy, "--select", "JL141", "--no-baseline")
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "JL141" in r.stdout and "stream_loader.py" in r.stdout
+    finally:
+        p.write_text(orig)
+
+
+def _ensure_abi_inputs(pkg_copy):
+    """pkg_copy holds only lightgbm_tpu/ — the ABI directives are inert
+    until the header/cpp they name exist at the matching relative
+    locations."""
+    inc = pkg_copy / "include" / "lightgbm_tpu"
+    if not inc.exists():
+        inc.mkdir(parents=True)
+        shutil.copy(REPO / "include" / "lightgbm_tpu" / "c_api.h",
+                    inc / "c_api.h")
+        capi = pkg_copy / "src" / "capi"
+        capi.mkdir(parents=True)
+        shutil.copy(REPO / "src" / "capi" / "lgbm_capi.cpp",
+                    capi / "lgbm_capi.cpp")
+
+
+def test_injected_jl151_skewed_binding_arity(pkg_copy):
+    """Dropping a parameter from the LGBM_ServeSwap binding while the
+    header still declares two must fire JL151 at the def."""
+    _ensure_abi_inputs(pkg_copy)
+    clean = _lint(pkg_copy, "--select", "JL151", "--no-baseline")
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    p, orig = _mutate(
+        pkg_copy, "lightgbm_tpu/c_api.py",
+        "def LGBM_ServeSwap(serve_handle, booster_handle):",
+        "def LGBM_ServeSwap(serve_handle):")
+    try:
+        r = _lint(pkg_copy, "--select", "JL151", "--no-baseline")
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "JL151" in r.stdout and "LGBM_ServeSwap" in r.stdout
+    finally:
+        p.write_text(orig)
+
+
+def test_injected_jl161_removed_registry_entry(pkg_copy):
+    """Deleting ``stream.parse`` from KNOWN_SITES while the loader
+    still arms it must fire JL161 at the arming call."""
+    p, orig = _mutate(pkg_copy, "lightgbm_tpu/robust/faults.py",
+                      '"stream.parse", "obs.export",',
+                      '"obs.export",')
+    try:
+        r = _lint(pkg_copy, "--select", "JL161", "--no-baseline")
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "JL161" in r.stdout and "stream.parse" in r.stdout
+        assert "stream_loader.py" in r.stdout
+    finally:
+        p.write_text(orig)
+
+
+def test_injected_jl161_dead_registry_entry(pkg_copy):
+    """Deleting the loader's ``faults.check("stream.parse")`` call
+    leaves a registry entry nothing arms — JL161 must flag it dead at
+    the KNOWN_SITES assignment."""
+    p, orig = _mutate(pkg_copy, "lightgbm_tpu/data/stream_loader.py",
+                      '        faults.check("stream.parse")\n', "")
+    try:
+        r = _lint(pkg_copy, "--select", "JL161", "--no-baseline")
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "JL161" in r.stdout and "stream.parse" in r.stdout
+        assert "faults.py" in r.stdout
+    finally:
+        p.write_text(orig)
+
+
 def test_baseline_has_no_project_rule_entries():
     """New rules start at zero debt: the committed baseline may not
     contain a single JL1xx entry."""
@@ -446,6 +540,34 @@ def test_cache_invalidated_by_content_change(tmp_path):
     res2 = jaxlint.analyze_paths([str(corpus_copy)], root=str(tmp_path),
                                  cache_dir=str(cache))
     assert res2.from_cache
+
+
+def test_cache_invalidated_by_abi_input_edit(tmp_path):
+    """Editing ONLY the C header a directive names — no .py content
+    changed — must invalidate the project tier: directive-declared
+    extra inputs are content-hashed into the tree sha."""
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "m.py").write_text(
+        "# jaxlint: abi-header=m.h\n"
+        "def LGBM_Fx(a, b):\n    return 0\n")
+    (proj / "m.h").write_text("int LGBM_Fx(int a, int b);\n")
+    cache = tmp_path / ".jaxlint_cache"
+    cold = jaxlint.analyze_paths([str(proj)], root=str(tmp_path),
+                                 cache_dir=str(cache))
+    assert not cold.findings
+    warm = jaxlint.analyze_paths([str(proj)], root=str(tmp_path),
+                                 cache_dir=str(cache))
+    assert warm.from_cache and not warm.findings
+    (proj / "m.h").write_text("int LGBM_Fx(int a, int b, int c);\n")
+    res = jaxlint.analyze_paths([str(proj)], root=str(tmp_path),
+                                cache_dir=str(cache))
+    assert not res.from_cache
+    assert [f.rule for f in res.findings] == ["JL151"]
+    res2 = jaxlint.analyze_paths([str(proj)], root=str(tmp_path),
+                                 cache_dir=str(cache))
+    assert res2.from_cache
+    assert [f.rule for f in res2.findings] == ["JL151"]
 
 
 def test_cache_select_run_filters_but_never_writes(tmp_path):
