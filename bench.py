@@ -643,20 +643,31 @@ def _cc_counters() -> dict:
     return compile_cache.counters()
 
 
-def _kernel_route_counts(snapshot_before: dict) -> dict:
-    """grow.hist.* routing counter deltas since ``snapshot_before`` —
-    which histogram kernel (einsum/pallas x bf16/int8) actually served
-    the dispatches of one benchmark leg."""
+def _kernel_route_counts(snapshot_before: dict,
+                         prefixes=("grow.hist.",
+                                   "grow.fused_find.")) -> dict:
+    """grow.hist.* / grow.fused_find.* routing counter deltas since
+    ``snapshot_before`` — which histogram kernel (einsum/pallas x
+    bf16/int8) actually served the dispatches of one benchmark leg, and
+    whether the find-best scan rode those dispatches (fused) or paid
+    its own.  grow.hist.* keys keep their historical short form
+    (``einsum_int8``); other prefixes keep a qualifier
+    (``fused_find.einsum_int8``) so the two families stay distinct."""
     from lightgbm_tpu import obs
     if not obs.enabled():
         return {}
     now = obs.registry().snapshot()["counters"]
     out = {}
     for key, val in sorted(now.items()):
-        if key.startswith("grow.hist."):
-            delta = val - snapshot_before.get(key, 0)
-            if delta:
-                out[key.split("grow.hist.", 1)[1]] = delta
+        for pre in prefixes:
+            if key.startswith(pre):
+                delta = val - snapshot_before.get(key, 0)
+                if delta:
+                    tag = key.split(pre, 1)[1]
+                    if pre != "grow.hist.":
+                        tag = pre.split("grow.", 1)[1] + tag
+                    out[tag] = delta
+                break
     return out
 
 
@@ -713,6 +724,13 @@ def run_quant(args) -> dict:
     legs = [
         ("f32", {"grad_quant_bits": 0}),
         ("int8_einsum", {"grad_quant_bits": 8, "hist_kernel": "einsum"}),
+        # the paired find-best leg: identical kernel/quant config to
+        # int8_einsum, but the gain scan pays its own dispatch per wave
+        # instead of riding the histogram program — the fused_delta
+        # block below is the tentpole's before/after on ONE dataset
+        ("int8_two_pass", {"grad_quant_bits": 8,
+                           "hist_kernel": "einsum",
+                           "find_best_fusion": "two_pass"}),
         ("int8_pallas", {"grad_quant_bits": 8,
                          "hist_kernel": pallas_mode}),
     ]
@@ -729,14 +747,22 @@ def run_quant(args) -> dict:
             bst, args.iters, args.chunk)
         per_iter = timed_s / max(iters_timed, 1)
         grower = getattr(bst, "_grower", None)
+        wpt = _waves_per_tree(bst)
+        fused = bool(getattr(grower, "fused_find", False))
         leg_out[name] = {
             "ms_per_tree": round(1000.0 * per_iter, 2),
             "timed_s": round(timed_s, 3),
             "timed_iters": iters_timed,
             "warmup_compile_s": round(t_warm + t_init, 2),
-            "waves_per_tree": _waves_per_tree(bst),
+            "waves_per_tree": wpt,
             "hist_kernel_tag": getattr(grower, "hist_kernel_tag", None),
             "int_scan": bool(getattr(grower, "int_scan", False)),
+            "find_best_fusion": getattr(grower, "find_fusion", None),
+            # program dispatches per tree under the leg's layout: a
+            # fused wave is ONE dispatch, two-pass pays the second
+            # find-best program every wave
+            "dispatches_per_tree": round(wpt * (1 if fused else 2), 2)
+            if wpt is not None else None,
             "kernel_dispatches": _kernel_route_counts(before),
         }
 
@@ -762,9 +788,33 @@ def run_quant(args) -> dict:
             "f32_vs_int8_pallas": _speedup("f32", "int8_pallas"),
             "int8_einsum_vs_int8_pallas": _speedup("int8_einsum",
                                                    "int8_pallas"),
+            "two_pass_vs_fused": _speedup("int8_two_pass",
+                                          "int8_einsum"),
+        },
+        # the tentpole's before/after at matched kernel/quant config:
+        # fused (int8_einsum) vs two_pass on the SAME shared dataset
+        "fused_delta": {
+            "ms_per_tree_fused": leg_out["int8_einsum"]["ms_per_tree"],
+            "ms_per_tree_two_pass":
+                leg_out["int8_two_pass"]["ms_per_tree"],
+            "ms_per_tree_saved": round(
+                leg_out["int8_two_pass"]["ms_per_tree"]
+                - leg_out["int8_einsum"]["ms_per_tree"], 2),
+            "waves_per_tree_fused":
+                leg_out["int8_einsum"]["waves_per_tree"],
+            "waves_per_tree_two_pass":
+                leg_out["int8_two_pass"]["waves_per_tree"],
+            "dispatches_per_tree_fused":
+                leg_out["int8_einsum"]["dispatches_per_tree"],
+            "dispatches_per_tree_two_pass":
+                leg_out["int8_two_pass"]["dispatches_per_tree"],
         },
         "backend": backend,
         "device": str(jax.devices()[0]),
+        # ms_per_tree numbers from a non-TPU container validate parity
+        # and plumbing, not the chip: bench_compare skips cross-round
+        # "value" comparisons for chip-pending results
+        "chip_pending": backend != "tpu",
         "host_sentinel_ms": host_sentinel_ms(),
     }
 
@@ -920,7 +970,7 @@ def run_explain(args) -> dict:
     stage_ms = prof.get("stage_ms") or {}
     full_hist = wave.get("wave_hist", 0.0)
     hist_ms = sum(stage_ms.get(w, full_hist) for w in widths) * f
-    phases_ms = {"wave_hist": hist_ms}
+    fused_find = bool(getattr(grower, "fused_find", False))
     costs = dict(wave.get("costs") or {})
 
     def _scale_cost(name, mult):
@@ -929,10 +979,23 @@ def run_explain(args) -> dict:
             costs[name] = {k: (v * mult if v is not None else None)
                            for k, v in c.items()}
 
-    for name in ("find_best", "split_apply"):
-        if name in wave:
-            phases_ms[name] = wave[name] * wpt
-            _scale_cost(name, wpt)
+    if fused_find:
+        # fused find-best-in-wave: the gain scan rides the histogram
+        # program, so the replay prices ONE phase per wave — pricing
+        # hist and find as separate dispatches would claim a dispatch
+        # (and its fixed overhead) the fused layout never pays
+        phases_ms = {"fused_hist_find":
+                     hist_ms + wave.get("find_best", 0.0) * wpt}
+        _scale_cost("find_best", wpt)
+        if "split_apply" in wave:
+            phases_ms["split_apply"] = wave["split_apply"] * wpt
+            _scale_cost("split_apply", wpt)
+    else:
+        phases_ms = {"wave_hist": hist_ms}
+        for name in ("find_best", "split_apply"):
+            if name in wave:
+                phases_ms[name] = wave[name] * wpt
+                _scale_cost(name, wpt)
     if "score_update" in wave:
         phases_ms["score_update"] = wave["score_update"]
     # the per-wave histogram cost estimate follows the same wave
@@ -947,6 +1010,16 @@ def run_explain(args) -> dict:
         costs["wave_hist"] = {k: v * f for k, v in agg.items()}
     else:
         _scale_cost("wave_hist", wpt)
+    if fused_find:
+        # fold the hist and find cost estimates into the single fused
+        # phase so the FLOPs/bytes line up with the merged timing above
+        merged = {}
+        for name in ("wave_hist", "find_best"):
+            for k, v in (costs.pop(name, None) or {}).items():
+                if v is not None:
+                    merged[k] = merged.get(k, 0.0) + v
+        if merged:
+            costs["fused_hist_find"] = merged
     if psum is not None:
         phases_ms["psum"] = psum["psum_ms"] * wpt
         if psum.get("cost"):
@@ -964,6 +1037,9 @@ def run_explain(args) -> dict:
     result["stage_wave_ms"] = {str(k): v for k, v in stage_ms.items()}
     result["dispatch_floor_ms"] = wave.get("dispatch_floor")
     result["hist_kernel_tag"] = getattr(grower, "hist_kernel_tag", None)
+    result["find_best_fusion"] = getattr(grower, "find_fusion", None)
+    result["dispatches_per_tree"] = round(
+        wpt * (1 if fused_find else 2), 2)
     return result
 
 

@@ -83,7 +83,7 @@ from .split import (F_DEFAULT_LEFT, F_FEATURE, F_GAIN, F_IS_CAT, F_LEFT_C,
                     F_LEFT_G, F_LEFT_H, F_LEFT_OUT, F_RIGHT_C, F_RIGHT_G,
                     F_RIGHT_H, F_RIGHT_OUT, F_THRESHOLD, FeatureMeta,
                     NEG_INF, SplitHyper, find_best_split_impl,
-                    find_best_split_quant)
+                    find_best_split_quant, find_best_split_stack)
 
 # rows per histogram chunk: large chunks amortize MXU ramp-up; the
 # per-chunk one-hot (CH, G, NB) bf16 stays fusable into the dot operand
@@ -236,6 +236,7 @@ class GrowerPrograms:
     def __init__(self, *, num_data: int, num_groups: int, nb: int,
                  num_features: int, has_cat: bool, config,
                  plan: list, plan_source: str = "default",
+                 fusion: Optional[str] = None,
                  shard: Optional[ShardSpec] = None, mesh=None):
         self.config = config.clone()
         config = self.config
@@ -310,11 +311,26 @@ class GrowerPrograms:
         # routing attribution for BENCH digests: which kernel serves
         # the full-width stage (narrow stages always stay on the
         # einsum; multi-tile waves fall back to it too)
+        from .hist_pallas import fits_single_tile
         kern = "pallas" if (self.use_pallas
-                            and self.wave_width * self.hist_cols <= 128) \
+                            and fits_single_tile(self.wave_width,
+                                                 self.hist_cols)) \
             else "einsum"
         self.hist_kernel_tag = \
             f"{kern}_{'int8' if self.quant_bits else 'bf16'}"
+        # find-best placement inside the wave: "fused" keeps the gain
+        # scan in the SAME traced region as the histogram contraction —
+        # the fresh product and the parent-minus-sibling residual are
+        # scanned in place and no concatenated (2W, S, 3) tensor
+        # round-trips through HBM between them — while "two_pass" keeps
+        # the legacy concat layout.  The caller (get_grower_programs)
+        # resolves auto against a wave_plan=profiled verdict persisted
+        # for this signature; a direct construction without one adopts
+        # the default resolution here so the trace never depends on an
+        # unset attribute.
+        self.find_fusion = fusion if fusion in ("fused", "two_pass") \
+            else resolve_find_fusion(config)
+        self.fused_find = self.find_fusion == "fused"
         # recompile tracking: these TrackedJit wrappers are shared by
         # every grower that adopts this programs object, so in the
         # retrain-every-window pattern a warm window re-dispatches into
@@ -462,7 +478,9 @@ class GrowerPrograms:
         w = pending.shape[0]
         k = self.hist_cols
         quant = bool(self.quant_bits)
-        if self.use_pallas and w == self.wave_width and w * k <= 128:
+        from .hist_pallas import fits_single_tile
+        if self.use_pallas and w == self.wave_width \
+                and fits_single_tile(w, k):
             # the VMEM kernel packs all stat columns into one 128-lane
             # tile; wider (multi-tile) waves stay on the einsum
             # full-width stage: MXU cost is tile-bound regardless of W,
@@ -714,28 +732,31 @@ class GrowerPrograms:
         )
 
         has_cat = self.has_cat
-        find_one = functools.partial(find_best_split_impl, meta=meta,
-                                     hp=hyper, has_cat=has_cat)
-        find_q = functools.partial(find_best_split_quant, meta=meta,
-                                   hp=hyper, has_cat=has_cat)
+        # find-best placement for THIS trace: an explicit param wins,
+        # auto adopts the construction-time verdict (possibly the
+        # wave_plan=profiled winner).  Read from config inside the
+        # traced region on purpose — the mode shapes the trace, so it
+        # must stay in the program-cache signature (jaxlint JL101 pins
+        # that coupling; dropping it via _NON_TRACE_PARAMS would let a
+        # mode switch silently reuse the other mode's cached program).
+        fmode = str(self.config.find_best_fusion or "auto").lower()
+        fused_find = self.fused_find if fmode == "auto" \
+            else fmode == "fused"
 
         def evaluate(hists, totals, ids, depths, feature_mask):
-            """vmapped find-best over fresh leaves; gated by splittability.
-            Returns (packed (B,13), cat_member (B,256) bool, left_int
-            (B,3) i32 exact quantized-unit left totals — None unless the
-            int32 scan is active)."""
+            """find-best over ONE histogram stack (split.py
+            find_best_split_stack), gated by splittability.  Returns
+            (packed (B,13), cat_member (B,256) bool, left_int (B,3) i32
+            exact quantized-unit left totals — None unless the int32
+            scan is active)."""
             cons = jnp.asarray([-jnp.inf, jnp.inf], jnp.float32)
+            packed, catm, lint = find_best_split_stack(
+                hists, totals, cons, feature_mask, meta, hyper, has_cat,
+                scales=qscales if int_scan else None)
             if int_scan:
-                packed, catm, lint = jax.vmap(
-                    lambda h, t: find_q(h, t, qscales, cons,
-                                        feature_mask))(hists, totals)
                 ok = self._splittable(totals, depths,
                                       hess_scale=qscales[1]) & (ids >= 0)
             else:
-                packed, catm = jax.vmap(
-                    lambda h, t: find_one(h, t, cons, feature_mask))(
-                        hists, totals)
-                lint = None
                 ok = self._splittable(totals, depths) & (ids >= 0)
             gain = jnp.where(ok, packed[:, F_GAIN], NEG_INF)
             return packed.at[:, F_GAIN].set(gain), catm, lint
@@ -778,12 +799,37 @@ class GrowerPrograms:
 
             # 3. find-best for the new leaves (both siblings); reuse the
             # fresh/large buffers rather than re-gathering from hist
-            ids = jnp.concatenate([jnp.where(sm_ok, st.p_small, -1),
-                                   jnp.where(lg_ok, st.p_large, -1)])
-            hists2 = jnp.concatenate([fresh, large])
+            ids_s = jnp.where(sm_ok, st.p_small, -1)
+            ids_l = jnp.where(lg_ok, st.p_large, -1)
+            ids = jnp.concatenate([ids_s, ids_l])
             idc = jnp.clip(ids, 0, L - 1)
-            packed, catm, lint = evaluate(hists2, total[idc], ids,
-                                          st.depth[idc], feature_mask)
+            if fused_find:
+                # fused find-best-in-wave: the gain scan consumes the
+                # fresh histogram product and the parent-minus-sibling
+                # residual IN PLACE — no (2*Ws, S, 3) concatenated
+                # tensor materializes between the contraction and the
+                # scan, so XLA fuses the hist+find of a wave into one
+                # program region and only the packed winner records
+                # (and the residual scattered into the leaf state)
+                # survive it.  vmap is per-lane, so each half is
+                # bitwise the rows the concatenated scan would produce
+                # (tests/test_fused_find.py pins this per regime).
+                ics, icl = idc[:Ws], idc[Ws:]
+                pk_s, cm_s, li_s = evaluate(fresh, total[ics], ids_s,
+                                            st.depth[ics], feature_mask)
+                pk_l, cm_l, li_l = evaluate(large, total[icl], ids_l,
+                                            st.depth[icl], feature_mask)
+                packed = jnp.concatenate([pk_s, pk_l])
+                catm = jnp.concatenate([cm_s, cm_l])
+                lint = jnp.concatenate([li_s, li_l]) if int_scan \
+                    else None
+            else:
+                # two-pass layout: one concatenated (2*Ws, S, 3) stack
+                # scanned by a single second pass
+                hists2 = jnp.concatenate([fresh, large])
+                packed, catm, lint = evaluate(hists2, total[idc], ids,
+                                              st.depth[idc],
+                                              feature_mask)
             safe = jnp.where(ids >= 0, ids, L)
             best = st.best.at[safe].set(
                 jnp.where((ids >= 0)[:, None], packed, st.best[safe]))
@@ -1240,6 +1286,32 @@ def _config_digest(config) -> str:
     return hashlib.sha1(repr(items).encode()).hexdigest()
 
 
+def resolve_find_fusion(config, signature: Optional[tuple] = None) -> str:
+    """Resolve ``find_best_fusion`` to the concrete wave layout
+    ("fused" / "two_pass"): explicit values pass through; ``auto``
+    adopts a ``wave_plan=profiled`` fused-vs-two-pass verdict cached in
+    process or persisted beside the compile cache for this signature
+    (ops/stage_plan.py), else defaults to fused.  The resolved mode
+    joins the program-cache key in :func:`get_grower_programs` — two
+    processes whose ``auto`` resolves differently must re-trace, never
+    reuse the other layout's compiled program."""
+    mode = str(getattr(config, "find_best_fusion", "auto")
+               or "auto").lower()
+    if mode in ("fused", "two_pass"):
+        return mode
+    if signature is not None:
+        cached = stage_plan_mod.cached_fusion(signature)
+        if cached is None:
+            cached = stage_plan_mod.load_fusion(signature)
+            if cached is not None:
+                stage_plan_mod.cache_fusion(signature, cached,
+                                            persist=False)
+                obs.inc("grow.fusion_persisted_loads")
+        if cached in ("fused", "two_pass"):
+            return cached
+    return "fused"
+
+
 def programs_signature(num_data: int, num_groups: int, nb: int,
                        num_features: int, has_cat: bool, config,
                        shard: Optional[ShardSpec] = None) -> tuple:
@@ -1293,13 +1365,18 @@ def get_grower_programs(num_data: int, num_groups: int, nb: int,
     if plan is None:
         plan = default_stage_plan(num_data, config)
     pd = stage_plan_mod.plan_digest(plan)
+    # resolved find-best layout: like the plan digest, auto's verdict
+    # is resolved HERE (once) and keyed — a cached entry built under
+    # the other layout must never serve this resolution
+    fusion = resolve_find_fusion(config, base)
     build = functools.partial(
         GrowerPrograms, num_data=num_data, num_groups=num_groups, nb=nb,
         num_features=num_features, has_cat=has_cat, config=config,
-        plan=plan, plan_source=plan_source, shard=shard, mesh=mesh)
+        plan=plan, plan_source=plan_source, fusion=fusion, shard=shard,
+        mesh=mesh)
     if not bool(getattr(config, "grower_cache", True)):
         return build()
-    key = base + (pd,)
+    key = base + (pd, fusion)
     with _PROGRAM_CACHE_LOCK:
         progs = _PROGRAM_CACHE.get(key)
         if progs is not None:
@@ -1523,6 +1600,15 @@ class DeviceGrower:
         # routing attribution: which kernel serves this dispatch's
         # full-width histogram stage (BENCH digests read these)
         obs.inc(f"grow.hist.{self.programs.hist_kernel_tag}")
+        # fused-find twin counters (same tag family as grow.hist.*):
+        # under find_best_fusion=fused each wave's hist+find is ONE
+        # dispatch equivalent, two_pass prices two — rollups multiply
+        # wave counts by the factor gauge instead of assuming 2/wave
+        # (the PR-16 counts-as-waves bug class)
+        if self.programs.fused_find:
+            obs.inc(f"grow.fused_find.{self.programs.hist_kernel_tag}")
+        obs.set_gauge("grow.wave_dispatch_factor",
+                      1 if self.programs.fused_find else 2)
         if self.programs.shard is not None:
             obs.inc("grow.sharded_dispatches")
         ti = jnp.asarray(tree_idx, jnp.int32)
@@ -1574,9 +1660,17 @@ class DeviceGrower:
 
         kernel_tag = self.programs.hist_kernel_tag
         sharded = self.programs.shard is not None
+        fused_find = self.programs.fused_find
 
         def run(binned, binned_t, score, lr, gargs, it0, grad_fn):
             obs.inc(f"grow.hist.{kernel_tag}")
+            # fused-find twin + dispatch factor: mirror of the
+            # per-iteration site so fused-chunk rollups price waves
+            # with the same 1-vs-2 dispatch accounting
+            if fused_find:
+                obs.inc(f"grow.fused_find.{kernel_tag}")
+            obs.set_gauge("grow.wave_dispatch_factor",
+                          1 if fused_find else 2)
             if sharded:
                 obs.inc("grow.sharded_dispatches")
             if row_pad:
@@ -1663,6 +1757,7 @@ class DeviceGrower:
             return fn, leaf, ghk, pend
 
         stage_cost = {}
+        hist_out = {}
         for w in widths:
             fn, leaf, ghk, pend = probe_for(w)
             jax.block_until_ready(fn(self.binned, leaf, ghk, pend))
@@ -1673,6 +1768,7 @@ class DeviceGrower:
                     r = fn(self.binned, leaf, ghk, pend)
                 jax.block_until_ready(r)
                 ms = (_time.perf_counter() - t0) / reps * 1e3
+            hist_out[w] = r
             stage_ms[w] = round(ms, 3)
             if obs.profile.enabled():
                 # static XLA estimate for the already-compiled probe (a
@@ -1697,22 +1793,116 @@ class DeviceGrower:
         fixed, col = stage_plan_mod.fit_wave_costs(
             widths, [stage_ms[w] for w in widths], k,
             num_data=progs.num_data)
-        plan = stage_plan_mod.derive_stage_plan(
-            progs.num_leaves, progs.wave_width, k, fixed, col,
-            measured_ms=stage_ms)
+
+        # fused-vs-two-pass verdict (find_best_fusion=auto): time the
+        # per-width gain scan both ways — as its own second program
+        # over a materialized (2W, S, 3) stack (the two-pass wave's
+        # extra dispatch) and riding the histogram program end-to-end
+        # (the fused wave) — then price a full tree under each layout
+        # and persist the winner beside the stage plan.  An explicit
+        # find_best_fusion skips the measurement: the layout is forced.
+        find_ms, fused_ms = {}, {}
+        fusion_cfg = str(getattr(self.config, "find_best_fusion",
+                                 "auto") or "auto").lower()
+        fusion = fusion_cfg if fusion_cfg in ("fused", "two_pass") \
+            else "fused"
+        fusion_detail = None
+        if fusion_cfg == "auto":
+            mask_all = jnp.ones((progs.num_features,), bool)
+            stack_scales = scales if progs.int_scan else None
+
+            def scan_stack(hists, m):
+                cons = jnp.asarray([-jnp.inf, jnp.inf], jnp.float32)
+                totals = hists[:, :progs.nb, :].sum(1)
+                packed, _, _ = find_best_split_stack(
+                    hists, totals, cons, m, self.meta, self.hyper,
+                    progs.has_cat, scales=stack_scales)
+                return packed
+
+            def timed(fn, *args):
+                jax.block_until_ready(fn(*args))
+                t0 = _time.perf_counter()
+                for _ in range(reps):
+                    r = fn(*args)
+                jax.block_until_ready(r)
+                return (_time.perf_counter() - t0) / reps * 1e3
+
+            for w in widths:
+                leaf = jnp.asarray(
+                    rng.integers(0, w, n).astype(np.int32))
+                pend = jnp.arange(w, dtype=jnp.int32)
+                # the negated fresh product stands in for the
+                # parent-minus-sibling residual: shape/dtype-faithful,
+                # and the scan cost is data-independent
+                h2 = jnp.concatenate([hist_out[w], -hist_out[w]])
+                two_fn = obs.track_jit(f"fusion_probe_find_w{w}",
+                                       jax.jit(scan_stack))
+                find_ms[w] = round(timed(two_fn, h2, mask_all), 3)
+
+                def fused_body(b, l, g2, p, m):
+                    fr = progs._wave_hist(b, l, g2, p, wave_scales)
+                    return jnp.concatenate([scan_stack(fr, m),
+                                            scan_stack(-fr, m)])
+
+                fused_fn = obs.track_jit(f"fusion_probe_fused_w{w}",
+                                         jax.jit(fused_body))
+                fused_ms[w] = round(
+                    timed(fused_fn, self.binned, leaf, ghk, pend,
+                          mask_all), 3)
+                obs.set_gauge(f"grow.find.w{w}_ms", find_ms[w])
+                obs.set_gauge(f"grow.fused.w{w}_ms", fused_ms[w])
+
+            plan_tp = stage_plan_mod.derive_stage_plan(
+                progs.num_leaves, progs.wave_width, k, fixed, col,
+                measured_ms=stage_ms, find_ms=find_ms,
+                fusion="two_pass")
+            plan_f = stage_plan_mod.derive_stage_plan(
+                progs.num_leaves, progs.wave_width, k, fixed, col,
+                measured_ms=fused_ms)
+            cost_tp, _ = stage_plan_mod.plan_cost_fn(
+                plan_tp, progs.num_leaves,
+                stage_plan_mod.wave_cost_fn(
+                    k, fixed, col, stage_ms, find_ms=find_ms,
+                    fusion="two_pass"))
+            cost_f, _ = stage_plan_mod.plan_cost_fn(
+                plan_f, progs.num_leaves,
+                stage_plan_mod.wave_cost_fn(k, fixed, col, fused_ms))
+            if cost_tp < cost_f * (1.0 - stage_plan_mod.MIN_IMPROVEMENT):
+                fusion, plan = "two_pass", plan_tp
+            else:
+                fusion, plan = "fused", plan_f
+            fusion_detail = {"fused_ms_per_tree": round(cost_f, 3),
+                             "two_pass_ms_per_tree": round(cost_tp, 3)}
+            obs.inc(f"grow.fusion_profiled.{fusion}")
+        else:
+            plan = stage_plan_mod.derive_stage_plan(
+                progs.num_leaves, progs.wave_width, k, fixed, col,
+                measured_ms=stage_ms, find_ms=find_ms or None,
+                fusion=fusion)
         if require_beat_legacy:
             legacy = stage_plan_mod.legacy_stage_plan(
                 progs.num_leaves, progs.wave_width, k)
+            meas = fused_ms if (fusion == "fused" and fused_ms) \
+                else stage_ms
             if not stage_plan_mod.plan_beats(
                     plan, legacy, progs.num_leaves, k, fixed, col,
-                    measured_ms=stage_ms):
+                    measured_ms=meas,
+                    find_ms=find_ms if fusion == "two_pass" else None,
+                    fusion=fusion):
                 plan = legacy
         obs.set_gauge("grow.stage.fixed_ms", round(fixed, 3))
         obs.set_gauge("grow.stage.col_ms", round(col, 5))
         installed = False
         if install:
             stage_plan_mod.cache_plan(self._base_signature, plan)
-            if plan != progs.stage_plan:
+            if fusion_cfg == "auto":
+                # the verdict persists beside the plan, so
+                # find_best_fusion=auto in THIS process (the rebuild
+                # below) and every fresh process resolves to it
+                stage_plan_mod.cache_fusion(self._base_signature,
+                                            fusion,
+                                            detail=fusion_detail)
+            if plan != progs.stage_plan or fusion != progs.find_fusion:
                 self.programs = get_grower_programs(
                     progs.num_data, progs.num_groups, progs.nb,
                     progs.num_features, progs.has_cat, self.config,
@@ -1727,6 +1917,8 @@ class DeviceGrower:
                 "fixed_ms": round(fixed, 3),
                 "col_ms": round(col, 5), "plan": plan,
                 "plan_digest": stage_plan_mod.plan_digest(plan),
+                "find_ms": find_ms, "fused_ms": fused_ms,
+                "fusion": fusion, "fusion_detail": fusion_detail,
                 "installed": installed}
 
     # ------------------------------------------------------------------

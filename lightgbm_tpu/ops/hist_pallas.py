@@ -46,6 +46,17 @@ from .. import obs
 _LANES = 128
 
 
+def fits_single_tile(w: int, k: int) -> bool:
+    """Whether a (wave width, stat columns) pair packs into one
+    128-lane VMEM tile — the kernel's eligibility condition.  The ONE
+    routing gate shared by the grower's dispatch site, its
+    ``hist_kernel_tag`` attribution and the bench suites, so the
+    counter-reported kernel can never diverge from the kernel that
+    actually ran (both the plain and the fused find-best wave route
+    their histogram product through this same check)."""
+    return w * k <= _LANES
+
+
 def _ceil_to(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
@@ -223,7 +234,7 @@ def _wave_hist_pallas(binned, leaf_id, ghk, pending, *, g: int, nb: int,
             f"pallas wave-histogram needs rows ({n}) divisible by its "
             f"chunk ({ch}); pad rows to a multiple (LGBM_TPU_CHUNK must "
             f"be a multiple of {ch} when using hist_kernel=pallas)")
-    if k * w > _LANES:
+    if not fits_single_tile(w, k):
         # a ValueError, not an assert: asserts vanish under `python -O`
         # and this is a caller-reachable configuration error (the grower
         # only routes w * k <= 128 waves here, but direct callers can

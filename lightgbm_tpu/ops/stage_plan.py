@@ -105,6 +105,19 @@ def plan_cost(plan: Sequence, num_leaves: int, hist_cols: int,
                         lambda w: fixed_ms + col_ms * w * hist_cols)
 
 
+def plan_dispatches(plan: Sequence, num_leaves: int,
+                    fused: bool = True) -> int:
+    """XLA program-dispatch equivalents for one tree under the plan:
+    a fused hist+find wave is ONE dispatch (the gain scan rides the
+    histogram program), while the two-pass layout pays a second
+    find-best program per wave.  The simulator's wave count itself is
+    layout-independent — fused waves count as one wave, never two
+    (the PR-16 counts-as-waves bug class) — only the dispatch factor
+    changes."""
+    _, waves = plan_cost_fn(plan, num_leaves, lambda w: 0.0)
+    return waves * (1 if fused else 2)
+
+
 def _ladder(wave_width: int) -> List[int]:
     out, w = [], 4
     while w < wave_width:
@@ -123,26 +136,44 @@ MIN_IMPROVEMENT = 0.02
 
 
 def wave_cost_fn(hist_cols: int, fixed_ms: float, col_ms: float,
-                 measured_ms: Optional[Dict[int, float]] = None):
+                 measured_ms: Optional[Dict[int, float]] = None,
+                 find_ms: Optional[Dict[int, float]] = None,
+                 fusion: str = "fused"):
     """Per-width wave cost (ms): the measured probe timing when one
     exists for the width, else the linear fixed + col * width * k model
     — shared by ``derive_stage_plan`` and ``plan_beats`` so the
-    derivation and the legacy-bar comparison price plans identically."""
+    derivation and the legacy-bar comparison price plans identically.
+
+    Fused-mode cost term: under ``fusion="fused"`` the find-best scan
+    rides the histogram program, so ``measured_ms`` should carry the
+    END-TO-END fused wave timings and nothing is added.  Under
+    ``fusion="two_pass"`` each wave pays the second find-best dispatch:
+    ``find_ms`` (width -> per-wave gain-scan ms, from the fusion
+    probes) is added on top of the histogram cost.  With no ``find_ms``
+    both modes price identically — the historical behaviour, so every
+    pre-fusion call site is unchanged."""
     def wave_ms(w):
-        if measured_ms and w in measured_ms:
-            return float(measured_ms[w])
-        return fixed_ms + col_ms * w * hist_cols
+        base = float(measured_ms[w]) if measured_ms and w in measured_ms \
+            else fixed_ms + col_ms * w * hist_cols
+        if fusion == "two_pass" and find_ms:
+            base += float(find_ms.get(w, 0.0))
+        return base
     return wave_ms
 
 
 def plan_beats(candidate: Sequence, incumbent: Sequence, num_leaves: int,
                hist_cols: int, fixed_ms: float, col_ms: float,
-               measured_ms: Optional[Dict[int, float]] = None) -> bool:
+               measured_ms: Optional[Dict[int, float]] = None,
+               find_ms: Optional[Dict[int, float]] = None,
+               fusion: str = "fused") -> bool:
     """Whether ``candidate``'s modeled per-tree cost beats
     ``incumbent``'s by the ``MIN_IMPROVEMENT`` bar — the gate
     ``wave_plan=auto`` applies before displacing the byte-stable legacy
-    ladder with a freshly measured plan."""
-    wave_ms = wave_cost_fn(hist_cols, fixed_ms, col_ms, measured_ms)
+    ladder with a freshly measured plan.  ``find_ms``/``fusion`` carry
+    the find-best placement pricing so the bar compares plans under
+    the SAME wave layout the derivation used."""
+    wave_ms = wave_cost_fn(hist_cols, fixed_ms, col_ms, measured_ms,
+                           find_ms=find_ms, fusion=fusion)
     c_cand, _ = plan_cost_fn(candidate, num_leaves, wave_ms)
     c_inc, _ = plan_cost_fn(incumbent, num_leaves, wave_ms)
     return c_cand < c_inc * (1.0 - MIN_IMPROVEMENT)
@@ -150,8 +181,10 @@ def plan_beats(candidate: Sequence, incumbent: Sequence, num_leaves: int,
 
 def derive_stage_plan(num_leaves: int, wave_width: int, hist_cols: int,
                       fixed_ms: float, col_ms: float,
-                      measured_ms: Optional[Dict[int, float]] = None
-                      ) -> Plan:
+                      measured_ms: Optional[Dict[int, float]] = None,
+                      find_ms: Optional[Dict[int, float]] = None,
+                      fusion: str = "fused",
+                      frontier_packing: bool = True) -> Plan:
     """Cheapest plan from the doubling-ladder family: every subset of
     intermediate widths {4, 8, 16, ...} (stage (w, 2w) runs width w
     until the leaf count outgrows it) closed by the full-width stage.
@@ -163,10 +196,26 @@ def derive_stage_plan(num_leaves: int, wave_width: int, hist_cols: int,
     is exactly what makes narrow early stages worthless on some shapes;
     the linear (fixed, col) model only fills unprobed widths.  Candidates
     are scanned fewest-stages-first and a longer plan must be at least
-    ``MIN_IMPROVEMENT`` cheaper to displace the incumbent."""
-    wave_ms = wave_cost_fn(hist_cols, fixed_ms, col_ms, measured_ms)
+    ``MIN_IMPROVEMENT`` cheaper to displace the incumbent.
+
+    ``frontier_packing`` is the knob that merges adjacent under-full
+    waves into one wider dispatch: a skipped ladder rung w hands its
+    frontier-w wave to the next stage's 2w-wide (initially half-empty)
+    dispatch, trading wasted lanes for one fewer wave.  Disabled, the
+    candidate set collapses to the single strictly width-matched full
+    ladder, so every wave runs at (at most) its frontier's width.
+    ``find_ms``/``fusion`` price the find-best placement per wave
+    (:func:`wave_cost_fn`): under two_pass each wave carries the second
+    gain-scan dispatch, which makes packed (fewer-wave) plans win
+    earlier than under fused pricing."""
+    wave_ms = wave_cost_fn(hist_cols, fixed_ms, col_ms, measured_ms,
+                           find_ms=find_ms, fusion=fusion)
 
     rungs = _ladder(wave_width)
+    full: Plan = [(w, 2 * w) for w in rungs
+                  if 2 * w < num_leaves] + [(wave_width, None)]
+    if not frontier_packing:
+        return full
     candidates: List[Plan] = [[(wave_width, None)]]
     for mask in range(1, 1 << len(rungs)):
         subset = [rungs[i] for i in range(len(rungs)) if mask >> i & 1]
@@ -307,6 +356,110 @@ def forget_plan(signature: tuple) -> None:
     with _PLAN_CACHE_LOCK:
         _PLAN_CACHE.pop(signature, None)
     path = _plan_path(signature)
+    if path is not None:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# fused-vs-two-pass verdicts: wave_plan=profiled times the find-best
+# scan in both wave layouts and the winner is recorded here, keyed and
+# persisted EXACTLY like the stage plan it was measured with (same
+# signature, same store beside the compile cache), so
+# ``find_best_fusion=auto`` resolves to the measured layout in this
+# process and every fresh process after it.  Like the plan, the
+# resolved mode shapes the traced program — ops/grow.py keys the
+# program cache on it — so a corrupt or mismatched file degrades to
+# the default (fused) rather than adopting an unvetted layout.
+# ---------------------------------------------------------------------------
+
+_FUSION_MODES = ("fused", "two_pass")
+_FUSION_CACHE: Dict[tuple, str] = {}
+
+
+def cached_fusion(signature: tuple) -> Optional[str]:
+    with _PLAN_CACHE_LOCK:
+        return _FUSION_CACHE.get(signature)
+
+
+def cache_fusion(signature: tuple, mode: str, persist: bool = True,
+                 detail: Optional[dict] = None) -> None:
+    """Record the measured find-best layout for ``signature`` in the
+    process cache and — unless ``persist=False`` — the on-disk store
+    (``persist=False`` is for verdicts that CAME from disk).
+    ``detail`` (e.g. the per-tree ms both layouts modeled) rides along
+    in the persisted file for bench/ops archaeology."""
+    if mode not in _FUSION_MODES:
+        raise ValueError(f"find-best fusion verdict must be one of "
+                         f"{_FUSION_MODES}, got {mode!r}")
+    with _PLAN_CACHE_LOCK:
+        _FUSION_CACHE[signature] = mode
+    if persist:
+        save_fusion(signature, mode, detail)
+
+
+def _fusion_path(signature: tuple) -> Optional[str]:
+    d = store_dir()
+    if d is None:
+        return None
+    key = hashlib.sha1(repr(tuple(signature)).encode()).hexdigest()[:20]
+    return os.path.join(d, f"fusion_{key}.json")
+
+
+def save_fusion(signature: tuple, mode: str,
+                detail: Optional[dict] = None) -> Optional[str]:
+    """Atomically persist the fusion verdict; best-effort like
+    :func:`save_plan` (a read-only cache dir must not take down
+    training over a verdict)."""
+    path = _fusion_path(signature)
+    if path is None:
+        return None
+    payload = {"signature": repr(tuple(signature)), "mode": str(mode)}
+    if detail:
+        payload["detail"] = detail
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh)
+        os.replace(tmp, path)
+    except OSError as e:
+        from ..utils.log import log_warning
+        log_warning(f"cannot persist the fused-find verdict to "
+                    f"{path}: {e}; the verdict stays process-local")
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    return path
+
+
+def load_fusion(signature: tuple) -> Optional[str]:
+    """Load a persisted fusion verdict; None (-> default fused) when
+    absent, unreadable, signature-mismatched, or not a known mode."""
+    path = _fusion_path(signature)
+    if path is None or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if payload.get("signature") != repr(tuple(signature)):
+        return None
+    mode = payload.get("mode")
+    return mode if mode in _FUSION_MODES else None
+
+
+def forget_fusion(signature: tuple) -> None:
+    """Drop ``signature``'s fusion verdict from the process cache AND
+    the disk store."""
+    with _PLAN_CACHE_LOCK:
+        _FUSION_CACHE.pop(signature, None)
+    path = _fusion_path(signature)
     if path is not None:
         try:
             os.remove(path)

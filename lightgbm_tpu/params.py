@@ -569,6 +569,27 @@ PARAM_SCHEMA: Sequence[Param] = (
             "so retrain windows AND fresh processes measure once "
             "(zero re-profiles; docs/ColdStart.md)",
        section="device"),
+    _p("find_best_fusion", str, "auto", (),
+       check="auto/fused/two_pass",
+       desc="find-best placement inside the device grower's wave "
+            "(ops/grow.py): fused = the wave's histogram contraction "
+            "feeds the per-feature gain scan in ONE traced program per "
+            "wave — the fresh and subtracted sibling histogram stacks "
+            "are scanned in place and only the packed winner records "
+            "plus the parent-minus-sibling residuals survive the wave, "
+            "never a concatenated (2*wave, slots, stats) tensor "
+            "round-tripping through HBM; two_pass = the legacy layout "
+            "(histograms materialize, then a second scan pass reduces "
+            "them); auto = fused, unless wave_plan=profiled measured "
+            "two-pass faster for this (shape, config) and persisted "
+            "that verdict beside the stage plan. Both paths are "
+            "byte-identical in every guaranteed regime (f32, int8 "
+            "einsum, int8 Pallas, striped columns, sharded "
+            "single-controller); the mode joins programs_signature so "
+            "switching retraces instead of reusing a stale program. "
+            "Per-wave dispatch equivalents are recorded as "
+            "grow.fused_find.* counters and the "
+            "grow.wave_dispatch_factor gauge", section="device"),
     _p("grower_cache", bool, True, (),
        desc="share the device grower's jitted programs process-wide, "
             "keyed on (shape signature, config hash): a warm retrain "

@@ -77,7 +77,13 @@ def extract_metrics(doc: dict) -> dict:
                 out[path] = (float(v), "lower")
             elif k in HIGHER_BETTER:
                 out[path] = (float(v), "higher")
-            elif k == "value":
+            elif k == "value" and not d.get("chip_pending"):
+                # chip-pending results (CPU-container evidence runs)
+                # mark their headline "value" as not-chip-truth: a
+                # cross-backend diff against a real TPU round's value
+                # would flag a bogus regression.  Named nested metrics
+                # (legs.*.ms_per_tree, ...) still compare — rounds of
+                # the SAME suite share those paths and stay guarded.
                 unit = str(d.get("unit", ""))
                 if unit in _VALUE_LOWER_UNITS:
                     out[path] = (float(v), "lower")
@@ -167,6 +173,16 @@ def self_test() -> int:
     assert [r["metric"] for r in rep["regressions"]] == ["value"], rep
     # crashed rounds (parsed: null) expose no metrics
     assert extract_metrics({"parsed": None, "rc": 1}) == {}
+    # chip-pending rounds keep named metrics but drop the headline
+    # "value" (a CPU container's value vs a TPU round's would diff
+    # seconds against milliseconds of different machines)
+    cp = {"metric": "m", "value": 9.0, "unit": "ms",
+          "chip_pending": True,
+          "legs": {"f32": {"ms_per_tree": 80.0}}}
+    m = extract_metrics(cp)
+    assert "value" not in m and "legs.f32.ms_per_tree" in m, m
+    rep = compare({"metric": "m", "value": 200.0, "unit": "s"}, cp, 0.10)
+    assert rep["compared"] == 0, rep
     print("bench_compare self-test OK")
     return 0
 

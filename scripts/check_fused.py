@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""CI fused-find smoke: fused find-best-in-wave == two-pass, byte-exact.
+
+Fast contract check for the fused histogram+gain-scan wave layout
+(``find_best_fusion``, ops/grow.py), run by ``scripts/check.sh``:
+
+1. two boosters differing only in ``find_best_fusion=fused`` vs
+   ``two_pass`` must emit byte-identical models — in f32 AND under the
+   ``grad_quant_bits=8`` int32 scan (where identity is exact-arithmetic
+   law, not luck);
+2. the routing counters must prove the fused leg actually dispatched
+   fused waves: ``grow.fused_find.*`` twins the leg's ``grow.hist.*``
+   count, and the ``grow.wave_dispatch_factor`` gauge reads 1 (fused)
+   vs 2 (two-pass).
+
+Runs on the CPU backend, so tier-1 CI gates the contract without a
+chip; ``bench.py --suite quant`` measures the fused-vs-two-pass pairing
+for real on the TPU driver.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("LGBM_TPU_CHUNK", "8192")
+
+ROWS = 3000
+FEATURES = 8
+PARAMS = {
+    "objective": "binary", "verbosity": -1, "device_growth": "on",
+    "num_leaves": 15, "max_bin": 63, "min_data_in_leaf": 5,
+    "seed": 20260807,
+}
+
+
+def _train(extra):
+    import numpy as np
+
+    from lightgbm_tpu.boosting import create_boosting
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data.dataset import BinnedDataset
+
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((ROWS, FEATURES)).astype(np.float32)
+    y = (x[:, 0] + np.abs(x[:, 1]) > 0.5).astype(np.float32)
+    cfg = Config({**PARAMS, **extra})
+    ds = BinnedDataset.construct_from_matrix(x, cfg)
+    ds.metadata.set_label(y)
+    bst = create_boosting(cfg)
+    bst.init_train(ds)
+    bst.train_chunked(4, chunk=2)
+    bst._flush_pending()
+    return bst
+
+
+def _trees(bst) -> str:
+    return bst.model_to_string().split("parameters:")[0]
+
+
+def _pair_identical(label, extra) -> bool:
+    from lightgbm_tpu import obs
+
+    before = obs.registry().snapshot()["counters"]
+    a = _train({**extra, "find_best_fusion": "fused"})
+    mid = obs.registry().snapshot()["counters"]
+    gauge_fused = obs.registry().snapshot()["gauges"].get(
+        "grow.wave_dispatch_factor")
+    b = _train({**extra, "find_best_fusion": "two_pass"})
+    after = obs.registry().snapshot()["counters"]
+    gauge_two = obs.registry().snapshot()["gauges"].get(
+        "grow.wave_dispatch_factor")
+
+    fused_hits = sum(
+        mid.get(k, 0) - before.get(k, 0)
+        for k in mid if k.startswith("grow.fused_find."))
+    hist_hits = sum(
+        mid.get(k, 0) - before.get(k, 0)
+        for k in mid if k.startswith("grow.hist."))
+    two_pass_fused_hits = sum(
+        after.get(k, 0) - mid.get(k, 0)
+        for k in after if k.startswith("grow.fused_find."))
+    if fused_hits <= 0 or fused_hits != hist_hits:
+        print(f"FAIL {label}: fused leg routing counters do not prove "
+              f"fused dispatch (grow.fused_find={fused_hits}, "
+              f"grow.hist={hist_hits})")
+        return False
+    if two_pass_fused_hits != 0:
+        print(f"FAIL {label}: two-pass leg incremented grow.fused_find "
+              f"({two_pass_fused_hits})")
+        return False
+    if gauge_fused != 1 or gauge_two != 2:
+        print(f"FAIL {label}: grow.wave_dispatch_factor gauge "
+              f"fused={gauge_fused} (want 1) two_pass={gauge_two} "
+              f"(want 2)")
+        return False
+    if _trees(a) != _trees(b):
+        print(f"FAIL {label}: fused and two-pass boosters produced "
+              f"different models")
+        return False
+    print(f"{label}: models byte-identical, {fused_hits} fused "
+          f"hist+find dispatches (factor 1 vs 2)")
+    return True
+
+
+def main() -> int:
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.utils.log import set_verbosity
+
+    set_verbosity(-1)
+    obs.configure(enabled=True)
+    ok = _pair_identical("f32 parity", {})
+    ok = _pair_identical("int8 parity", {"grad_quant_bits": 8}) and ok
+    print("fused-find smoke: " + ("PASS" if ok else "FAIL"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

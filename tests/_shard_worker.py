@@ -10,7 +10,7 @@ failing — the ROADMAP memory note: such failures in the CPU container
 are environmental, the contract is validated on real multi-chip.
 
 Usage: python _shard_worker.py <scenario> [outdir]
-Scenarios: core | bucketing | checkpoint
+Scenarios: core | bucketing | checkpoint | fused_find
 """
 
 import json
@@ -190,6 +190,27 @@ def scenario_checkpoint(outdir):
             "resume_identical": resumed == straight}
 
 
+def scenario_fused_find():
+    """Fused find-best-in-wave composed with sharding: under quant8
+    (the exact-arithmetic regime) the 4-device mesh must emit trees
+    byte-identical to the single-device run in BOTH wave layouts, and
+    the two layouts must agree with each other — the psum lands inside
+    the fused program directly ahead of the replicated gain scan
+    (ops/shard.py determinism contract)."""
+    x, y = _data()
+    q = {"grad_quant_bits": 8}
+    out = {}
+    ref = _train(x, y, {**q, "find_best_fusion": "fused"})
+    out["fused_1v4_identical"] = \
+        ref == _train(x, y, {**q, **SHARD, "find_best_fusion": "fused"})
+    two = _train(x, y, {**q, "find_best_fusion": "two_pass"})
+    out["two_pass_1v4_identical"] = \
+        two == _train(x, y,
+                      {**q, **SHARD, "find_best_fusion": "two_pass"})
+    out["fused_eq_two_pass"] = ref == two
+    return out
+
+
 def main():
     scenario = sys.argv[1] if len(sys.argv) > 1 else "core"
     outdir = sys.argv[2] if len(sys.argv) > 2 else "."
@@ -205,6 +226,8 @@ def main():
         out = scenario_bucketing()
     elif scenario == "checkpoint":
         out = scenario_checkpoint(outdir)
+    elif scenario == "fused_find":
+        out = scenario_fused_find()
     else:
         raise SystemExit(f"unknown scenario {scenario!r}")
     out["scenario"] = scenario

@@ -1,10 +1,12 @@
 """JL101 fixture: trace-key completeness around ``programs_signature``.
 
-Planted: a trace-shaping constant missing from the signature, a config
-attribute excluded from the key but read inside a traced region, and a
+Planted: a trace-shaping constant missing from the signature, a
+fusion-mode gating constant likewise unkeyed, a config attribute
+excluded from the key but read inside a traced region, and a
 runtime-traced attribute hashed into the key.  Exempt variants: a
 constant that IS in the key, a host bookkeeping bound whose compares
-never meet a shape, an ``int(...)`` structural config read, and a
+never meet a shape, an ``int(...)`` structural config read, a
+host-side fusion-mode string compare (no shape involved), and a
 suppressed occurrence.
 """
 
@@ -19,6 +21,11 @@ _CHUNK = 1024
 STRIPE_ROWS = 1 << 20
 _HOST_CACHE_MAX = 8
 _CACHE = {}
+
+# wave-layout knobs (the find_best_fusion idiom): the frontier bound
+# selects program structure, the default mode string never meets a shape
+FUSED_FIND_MIN_FRONTIER = 8
+DEFAULT_FIND_FUSION = "fused"
 
 _NON_TRACE_PARAMS = ("learning_rate", "plan_mode")
 
@@ -39,6 +46,10 @@ class Programs:
         self.n_pad = max(int(num_data), _CHUNK)
         self.striped = num_data >= STRIPE_ROWS   # PLANT: JL101
         self.num_leaves = int(config.num_leaves)
+        # the fused wave layout is only worth its trace above a frontier
+        # bound — which makes the bound trace-shaping, and unkeyed here
+        self.fused = \
+            self.num_leaves >= FUSED_FIND_MIN_FRONTIER  # PLANT: JL101
         self.lr = float(config.shrinkage)        # PLANT: JL101
         self.grow = obs.track_jit("fixture_grow", jax.jit(_grow_impl))
 
@@ -53,6 +64,12 @@ class Programs:
 def suppressed_variant(num_data):
     # jaxlint: disable-next=JL101
     return num_data >= STRIPE_ROWS
+
+
+def fusion_mode(config):
+    # exempt: a host-side mode-string compare — the constant never
+    # meets a shape, so it is resolution logic, not a trace key hole
+    return str(config.find_best_fusion) == DEFAULT_FIND_FUSION
 
 
 def _grow_impl(score, lr):

@@ -339,6 +339,25 @@ def test_injected_jl101_traced_param_in_key(pkg_copy):
         p.write_text(orig)
 
 
+def test_injected_jl101_fusion_mode_excluded_from_key(pkg_copy):
+    """Excluding find_best_fusion from the digest while ``_grow_impl``
+    reads it in the traced region (the fused-vs-two-pass wave-layout
+    branch) must fire JL101: the two layouts are different programs, so
+    an un-keyed mode would let a cached trace serve the other layout."""
+    p, orig = _mutate(
+        pkg_copy, "lightgbm_tpu/ops/grow.py",
+        '_NON_TRACE_PARAMS = ("wave_plan", "grower_cache", '
+        '"learning_rate")',
+        '_NON_TRACE_PARAMS = ("wave_plan", "grower_cache", '
+        '"learning_rate", "find_best_fusion")')
+    try:
+        r = _lint(pkg_copy, "--select", "JL101", "--no-baseline")
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "JL101" in r.stdout and "find_best_fusion" in r.stdout
+    finally:
+        p.write_text(orig)
+
+
 def test_injected_jl111_f32_upcast_in_quant_path(pkg_copy):
     """An f32 upcast on the int8 stat mask upstream of the dequantize
     point (the shape of PR-9's 'f32 dequantize left upstream of the
