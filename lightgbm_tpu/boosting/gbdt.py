@@ -292,7 +292,8 @@ class GBDT:
         # host sync) when the configuration is eligible
         mode = str(getattr(cfg, "device_growth", "off")).lower()
         from ..ops import shard as shard_mod
-        shard_wanted = shard_mod.sharding_mode(cfg) == "single_controller"
+        shard_wanted = shard_mod.sharding_mode(cfg) in (
+            "single_controller", "multi_controller")
         # data_sharding is an explicit opt-in, so device_growth=auto
         # turns the grower on for it even off-TPU (the sharded scan IS
         # the device grower; the host learner cannot shard this way)
@@ -322,7 +323,21 @@ class GBDT:
                 log_info("Using on-device tree growth (device_growth="
                          f"{mode})")
                 wp = str(getattr(cfg, "wave_plan", "auto")).lower()
-                if wp == "profiled":
+                if getattr(self._grower, "_multihost", False):
+                    # plan profiling is TIMING-derived: two pod hosts
+                    # measuring independently could adopt different
+                    # stage plans and trace DIFFERENT programs — the
+                    # mesh would deadlock on the first psum.  Every
+                    # host keeps the deterministic default ladder
+                    # (profiled plans come back when a broadcast-
+                    # verdict path exists)
+                    if wp == "profiled":
+                        log_warning(
+                            "wave_plan=profiled is disabled under "
+                            "data_sharding=multi_controller (per-host "
+                            "timing verdicts may diverge); using the "
+                            "fixed ladder")
+                elif wp == "profiled":
                     # measure per-stage wave cost on the real binned
                     # matrix and install the derived stage plan; the
                     # plan is cached per (shape, config) signature (in
@@ -352,11 +367,27 @@ class GBDT:
                     # it from disk instead of re-measuring.
                     self._grower.profile_stage_plan(
                         require_beat_legacy=True)
+            elif shard_mod.sharding_mode(cfg) == "multi_controller":
+                # a pod host cannot silently fall back to the host
+                # learner: its dataset may be a local shard and its
+                # peers would wedge on the histogram psum
+                raise LightGBMError(
+                    "data_sharding=multi_controller requires the "
+                    "device grower (tree_learner=serial and an "
+                    "eligible configuration: no monotone constraints/"
+                    "renew objective/forced splits, dataset under the "
+                    "striped-count bound) — refusing to fall back on "
+                    "a pod slice")
             elif mode == "on":
                 log_warning("device_growth=on requested but the "
                             "configuration is not eligible (monotone "
                             "constraints/renew objective/forced splits); "
                             "falling back to the host-driven learner")
+        elif shard_mod.sharding_mode(cfg) == "multi_controller":
+            raise LightGBMError(
+                "data_sharding=multi_controller requires device_growth"
+                "=on|auto (the pod-slice trainer IS the fused device "
+                "scan)")
 
     def add_valid(self, valid_set: BinnedDataset, name: str):
         if not valid_set.check_align(self.train_set):
@@ -454,7 +485,22 @@ class GBDT:
         obs.sample_device_memory()
         return out
 
+    def _forbid_host_path(self, what: str) -> None:
+        """The host learner's row-global paths (its own ``train``,
+        traversal-based score updates) index the FULL binned matrix; a
+        pod-slice host only holds its own row block, so reaching them
+        under ``data_sharding=multi_controller`` must fail loudly
+        instead of training on garbage rows."""
+        if getattr(self._grower, "_multihost", False):
+            raise LightGBMError(
+                f"{what} is not supported under data_sharding="
+                f"multi_controller: it needs the host learner's full "
+                f"binned matrix, and a pod-slice host holds only its "
+                f"own row block")
+
     def _train_one_iter_host(self, gradients=None, hessians=None) -> bool:
+        self._forbid_host_path("host-path training (custom gradients "
+                              "or device_growth fallback)")
         init_scores = [0.0] * self.num_model
         if gradients is None or hessians is None:
             for k in range(self.num_model):
@@ -972,6 +1018,7 @@ class GBDT:
         the same index is re-applied at the next catch-up."""
         if not self.models:
             return
+        self._forbid_host_path("rollback_one_iter")
         self._flush_pending()
         base = len(self.models) - self.num_model
         for k in range(self.num_model):
@@ -1332,8 +1379,17 @@ class GBDT:
         plus a ``.state.npz`` sidecar carrying the EXACT float32
         training scores and the iteration counter.  Both land via
         write-temp-then-rename, so a crash mid-save leaves the previous
-        checkpoint intact."""
+        checkpoint intact.  Under ``data_sharding=multi_controller``
+        this becomes the pod-slice commit protocol
+        (robust/checkpoint.py): every host acks its state digest, host
+        0 writes the payload and the commit marker only after ALL acks
+        land, peers block on the marker — a host killed mid-window
+        leaves the snapshot uncommitted."""
         self._flush_pending()
+        if (self._grower is not None
+                and getattr(self._grower, "_multihost", False)):
+            self._save_checkpoint_pod(path)
+            return
         _checkpoint.atomic_write_text(path, self.model_to_string())
         # the host learner's feature_fraction stream is the one draw
         # that is NOT (seed, iteration)-derived; snapshot it too
@@ -1343,6 +1399,40 @@ class GBDT:
             np.asarray(self.train_score, np.float32), self.iter,
             rng_state=rng.get_state() if rng is not None else None)
         log_info(f"Saved training checkpoint to {path}")
+
+    def _save_checkpoint_pod(self, path: str) -> None:
+        """Pod-slice commit protocol (see :meth:`save_checkpoint`)."""
+        import jax as _jax
+        from ..parallel.network import network_policy_from_config
+        rank = int(_jax.process_index())
+        hosts = int(_jax.process_count())
+        model_str = self.model_to_string()
+        score = np.asarray(self.train_score, np.float32)
+        # digest over the TREES only: the parameters echo legitimately
+        # differs per host (host_rank), the trees must not
+        digest = _checkpoint.pod_state_digest(
+            model_str.split("\nparameters:", 1)[0], score, self.iter)
+        attempts, timeout_s = network_policy_from_config(self.config)
+        deadline = max(10.0, float(attempts) * float(timeout_s))
+        _checkpoint.write_pod_ack(path, rank, digest)
+        if rank == 0:
+            _checkpoint.await_pod_acks(path, hosts, digest,
+                                       timeout_s=deadline)
+            # clear BEFORE the commit marker: a peer starts its next
+            # ack only after seeing this commit, so post-commit
+            # clearing could race and delete the peer's fresh ack
+            _checkpoint.clear_pod_acks(path, hosts)
+            _checkpoint.atomic_write_text(path, model_str)
+            rng = getattr(getattr(self, "learner", None), "_rng", None)
+            _checkpoint.save_train_state(
+                path + ".state.npz", score, self.iter,
+                rng_state=rng.get_state() if rng is not None else None)
+            _checkpoint.commit_pod(path, digest)
+            log_info(f"Committed pod checkpoint {path} "
+                     f"({hosts} host acks)")
+        else:
+            _checkpoint.await_pod_commit(path, digest,
+                                         timeout_s=deadline)
 
     def resume_from_checkpoint(self, path: str) -> "GBDT":
         """Adopt a :meth:`save_checkpoint` snapshot AFTER
@@ -1356,6 +1446,15 @@ class GBDT:
             raise LightGBMError(
                 "resume_from_checkpoint requires init_train first "
                 "(the training scores are sized by the dataset)")
+        if (getattr(self._grower, "_multihost", False)
+                and not _checkpoint.has_pod_commit(path)):
+            # a snapshot some host never acked may be mid-write or
+            # inconsistent across the slice — resuming from it would
+            # diverge the pod on the first collective
+            raise LightGBMError(
+                f"snapshot {path} has no pod commit marker "
+                f"({_checkpoint.pod_commit_path(path)}); refusing to "
+                f"resume a pod slice from an uncommitted snapshot")
         state = _checkpoint.load_train_state(path + ".state.npz")
         if state is None:
             raise LightGBMError(

@@ -45,6 +45,25 @@ def parse_cli_args(argv: List[str]) -> Dict[str, str]:
 def _load_dataset(path: str, cfg: Config, reference=None) -> BinnedDataset:
     if BinnedDataset.is_binary_file(path):
         return BinnedDataset.load_binary(path)
+    from .ops.shard import sharding_mode
+    if sharding_mode(cfg) == "multi_controller" and reference is None:
+        # pod-slice ingest: host 0 finds bins, every host streams and
+        # bins only its own contiguous row block (docs/Sharding.md)
+        from .data.stream_loader import load_text_multihost
+        cats = _parse_categorical(cfg, 1 << 30)
+        ds, _ = load_text_multihost(path, cfg, categorical=cats)
+        md = ds.metadata
+        w = load_weight_file(path + ".weight")
+        if w is not None:
+            md.set_weights(w)
+        q = load_query_file(path + ".query")
+        if q is not None:
+            md.set_query(q)
+        init = load_init_score_file(path + ".init")
+        if init is not None:
+            md.set_init_score(init.T.reshape(-1) if init.ndim > 1
+                              else init)
+        return ds
     if getattr(cfg, "two_round", False):
         # streaming two-round load: never materializes the float64
         # matrix (dataset_loader.cpp:161-264, pipeline_reader.h:19-66)

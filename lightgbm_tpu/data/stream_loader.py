@@ -19,10 +19,12 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from .. import obs
 from ..robust import faults
 from ..utils.file_io import open_text
 from ..utils.log import LightGBMError, log_info
@@ -252,22 +254,14 @@ def iter_parsed_chunks(path: str, config, num_features: int):
                                    num_features)
 
 
-def load_text_two_round(path: str, config, categorical=(),
-                        reference=None):
-    """Stream-load ``path`` into a BinnedDataset without materializing
-    the float64 matrix (dataset_loader.cpp:161-264 semantics).
-
-    Returns ``(dataset, label)``.
-    """
-    from .dataset import BinnedDataset
-
-    if not os.path.exists(path):
-        raise LightGBMError(f"could not open data file {path}")
-    fmt = _Format(path, config)
+def _round_one(path: str, fmt: "_Format", config
+               ) -> Tuple[np.ndarray, int, int]:
+    """Round one of a two-round load: stream the file once behind the
+    double-buffered reader, count rows, grow the libsvm column bound,
+    and reservoir-sample ``bin_construct_sample_cnt`` rows for bin
+    finding.  Returns ``(sample, n_total, num_cols)``."""
     sample_cnt_target = int(config.bin_construct_sample_cnt)
     rng = np.random.default_rng(config.data_random_seed & 0x7FFFFFFF)
-
-    # ---- round one: count rows, reservoir-sample for bin finding ------
     n_total = 0
     num_cols = fmt.num_cols
     reservoir: Optional[np.ndarray] = None      # (sample, F) float64
@@ -315,6 +309,51 @@ def load_text_two_round(path: str, config, categorical=(),
     sample = reservoir[:res_filled]
     log_info(f"two-round load: {n_total} rows, sampled {res_filled} "
              f"for bin finding ({fmt.kind})")
+    return sample, n_total, num_cols
+
+
+def _round_two(path: str, fmt: "_Format", ds, num_cols: int,
+               n_total: int,
+               row_span: Optional[Tuple[int, int]] = None) -> np.ndarray:
+    """Round two: re-stream the file and bin chunk-wise into the
+    preallocated ``(N, G)`` matrix; returns the full label vector.
+
+    ``row_span=(lo, hi)`` restricts BINNING to the global row block
+    ``[lo, hi)``, pushed at LOCAL coordinates ``row - lo`` — the
+    host-sharded ingest path, where ``ds`` holds only this host's
+    padded block.  Labels are always parsed for every row (gradients
+    are computed host-side from the replicated score, so every pod
+    host needs the global label vector).  The double-buffered reader's
+    liveness timeout and parse-location errors apply to the filtered
+    path unchanged."""
+    start = 0
+    label = np.zeros(n_total, np.float64)
+    lo, hi = row_span if row_span is not None else (0, n_total)
+    for line_no, lines in _chunk_reader(path, fmt.header):
+        x, y = _parse_chunk_checked(fmt, path, line_no, lines, num_cols)
+        m = x.shape[0]
+        label[start:start + len(y)] = y
+        a, b = max(start, lo), min(start + m, hi)
+        if a < b:
+            ds.construct_streaming_push(x[a - start:b - start], a - lo)
+        start += m
+    ds.construct_streaming_finish()
+    return label
+
+
+def load_text_two_round(path: str, config, categorical=(),
+                        reference=None):
+    """Stream-load ``path`` into a BinnedDataset without materializing
+    the float64 matrix (dataset_loader.cpp:161-264 semantics).
+
+    Returns ``(dataset, label)``.
+    """
+    from .dataset import BinnedDataset
+
+    if not os.path.exists(path):
+        raise LightGBMError(f"could not open data file {path}")
+    fmt = _Format(path, config)
+    sample, n_total, num_cols = _round_one(path, fmt, config)
 
     # ---- bin finding + bundling from the sample ------------------------
     ds = BinnedDataset.construct_streaming_begin(
@@ -322,13 +361,127 @@ def load_text_two_round(path: str, config, categorical=(),
         feature_names=fmt.names, reference=reference)
 
     # ---- round two: bin chunk-wise into the (N, G) matrix --------------
-    start = 0
-    label = np.zeros(n_total, np.float64)
-    for line_no, lines in _chunk_reader(path, fmt.header):
-        x, y = _parse_chunk_checked(fmt, path, line_no, lines, num_cols)
-        ds.construct_streaming_push(x, start)
-        label[start:start + len(y)] = y
-        start += x.shape[0]
-    ds.construct_streaming_finish()
+    label = _round_two(path, fmt, ds, num_cols, n_total)
     ds.metadata.set_label(label)
+    return ds, label
+
+
+def load_text_multihost(path: str, config, categorical=()):
+    """Pod-slice two-round streaming load (docs/Sharding.md).
+
+    Bins and bundles must be found ONCE for the whole pod — per-host
+    bin finding would give each host different mappers and silently
+    diverge the models — so host 0 runs round one over the full file
+    (count + reservoir sample + find-bin, exactly the single-process
+    path) and broadcasts the serialized mapper reference over the blob
+    plane one port above the coordinator.  Every host (including host
+    0, for byte-identical mapper state) then rebuilds the skeleton
+    from the SAME bytes, allocates only its contiguous padded row
+    block ``[lo, hi)`` of the pod layout, and streams round two
+    locally: labels parse globally, binning is row-span filtered, so
+    the ``(N, G)`` matrix memory and binning compute scale per host.
+
+    Returns ``(dataset, label)`` where ``dataset.num_data`` is the
+    GLOBAL row count, ``dataset.binned`` holds only this host's padded
+    block, and ``dataset.host_shard`` / ``dataset.host_row_span`` mark
+    the layout for ``DeviceGrower`` (which validates the span).
+
+    A peer that dies during ingest surfaces as a
+    :class:`LightGBMError` naming the host and file: the reference
+    broadcast and the post-ingest layout handshake both ride the
+    deadline-bound blob plane (host 0 names the hosts that never
+    connected; peers get the ``net.connect`` retry error), and parse /
+    reader-thread failures inside the filtered round keep their file +
+    line context, prefixed with this host's rank.
+    """
+    from .dataset import BinnedDataset
+    from ..ops.shard import (make_pod_mesh, multihost_params,
+                             multihost_setup, process_row_span,
+                             shard_local_rows)
+    from ..parallel.network import broadcast_blob, pod_broadcast_address
+    from ..pipeline.bins import (reference_from_bytes,
+                                 reference_layout_digest,
+                                 reference_to_bytes)
+
+    resolved = multihost_params(config)
+    if resolved is None:
+        raise LightGBMError(
+            "load_text_multihost: no coordinator configured — set "
+            "coordinator_address/num_hosts/host_rank (or the "
+            "LGBM_TPU_COORDINATOR/LGBM_TPU_NUM_HOSTS/"
+            "LGBM_TPU_HOST_RANK env vars)")
+    coord = resolved[0]
+    rank, hosts = multihost_setup(config)
+    mesh = make_pod_mesh()
+    addr = pod_broadcast_address(coord)
+
+    def _blob_round(payload, what):
+        try:
+            return broadcast_blob(payload, address=addr,
+                                  num_hosts=hosts, rank=rank,
+                                  config=config)
+        except LightGBMError as e:
+            raise LightGBMError(
+                f"sharded ingest of {path} failed on host {rank} "
+                f"during {what}: {e}") from e
+
+    if not os.path.exists(path):
+        raise LightGBMError(
+            f"could not open data file {path} (host {rank})")
+    fmt = _Format(path, config)
+
+    # ---- round one on host 0 only, reference over the blob plane ------
+    blob = None
+    if rank == 0:
+        sample, n_total, num_cols = _round_one(path, fmt, config)
+        ref = BinnedDataset.construct_streaming_begin(
+            sample, n_total, num_cols, config, categorical,
+            feature_names=fmt.names)
+        ref.binned = None     # mappers/bundles only; blocks stay local
+        blob = reference_to_bytes(
+            ref, extra={"n_total": n_total, "num_cols": num_cols})
+    blob = _blob_round(blob, "mapper-reference broadcast")
+    skeleton, extra = reference_from_bytes(blob)
+    n_total = int(extra["n_total"])
+    num_cols = int(extra["num_cols"])
+    if fmt.kind == "libsvm":
+        fmt.num_cols = num_cols   # adopt host 0's global column bound
+
+    # ---- this host's contiguous padded block of the pod row layout ----
+    n_loc = shard_local_rows(n_total, int(mesh.devices.size), config)
+    lo, hi = process_row_span(mesh, n_loc)
+    ds = BinnedDataset.construct_streaming_begin(
+        np.zeros((0, num_cols)), hi - lo, num_cols, config, categorical,
+        feature_names=fmt.names, reference=skeleton)
+
+    # ---- round two: parse globally, bin this host's span locally ------
+    t0 = time.perf_counter()
+    try:
+        label = _round_two(path, fmt, ds, num_cols, n_total,
+                           row_span=(lo, hi))
+    except LightGBMError as e:
+        raise LightGBMError(f"[host {rank}] {e}") from e
+    binned_rows = max(0, min(hi, n_total) - min(lo, n_total))
+    obs.set_gauge("ingest.rows_per_s",
+                  binned_rows / max(time.perf_counter() - t0, 1e-9))
+
+    # ---- flip to the global-row contract the grower validates ---------
+    ds.num_data = n_total
+    ds.metadata = type(ds.metadata)(n_total)
+    ds.host_shard = True
+    ds.host_row_span = (lo, hi)
+    ds.metadata.set_label(label)
+
+    # ---- post-ingest handshake: liveness barrier + layout cross-check -
+    my_digest = reference_layout_digest(ds).encode()
+    echoed = _blob_round(my_digest if rank == 0 else None,
+                         "post-ingest layout handshake")
+    if echoed != my_digest:
+        raise LightGBMError(
+            f"host {rank} binned {path} with a different feature "
+            f"layout than host 0 (digest {my_digest.decode()[:12]} vs "
+            f"{echoed.decode()[:12]}); pod ingest diverged")
+    log_info(f"multihost load: host {rank}/{hosts} holds rows "
+             f"[{lo}, {hi}) of {n_total} "
+             f"({binned_rows} real, {fmt.kind})")
     return ds, label

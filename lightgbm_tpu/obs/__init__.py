@@ -524,6 +524,17 @@ def summary() -> Dict:
             "psum_ms": round(psum["p50_s"] * 1e3, 3) if psum else None,
             "psum_probes": psum["count"] if psum else 0,
         }
+        hosts = snap["gauges"].get("shard.hosts")
+        if hosts and int(hosts) > 1:
+            # pod-slice training: per-host ingest throughput and the
+            # mapper-broadcast traffic join the shard digest so a
+            # multi-controller run is distinguishable from a local
+            # mesh at a glance (docs/Observability.md)
+            out["shard"]["hosts"] = int(hosts)
+            out["shard"]["ingest_rows_per_s"] = snap["gauges"].get(
+                "ingest.rows_per_s")
+            out["shard"]["broadcast_bytes"] = snap["counters"].get(
+                "net.broadcast_bytes", 0)
     injected = sum(v for k, v in snap["counters"].items()
                    if k.startswith("fault."))
     retries = snap["counters"].get("retry.attempts", 0)

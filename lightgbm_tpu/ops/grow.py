@@ -1451,20 +1451,15 @@ class DeviceGrower:
             # canonical global shape instead of the bucket (same
             # reasoning as the unsharded quant/bucketing exclusion), so
             # they shard exact per-shard rows.
-            from .shard import SHARD_AXIS
+            from .shard import (SHARD_AXIS, mesh_is_multihost,
+                                shard_local_rows)
             d = int(self.mesh.devices.size)
-            srows = -(-self.num_data // d)
-            if row_bucketing and not quant_on:
-                b = bucket_size(max(srows, 1))
-                if b >= 2 * COUNT_SPLIT_ROWS:
-                    from ..utils.log import log_info
-                    log_info(
-                        f"train_row_bucketing: per-shard bucket {b} "
-                        f"would reach the striped-count bound; using "
-                        f"exact per-shard rows ({srows})")
-                else:
-                    srows = b
-            n_loc = _ceil_to(max(srows, _CHUNK), _CHUNK)
+            n_loc = shard_local_rows(self.num_data, d, config,
+                                     row_bucketing=row_bucketing)
+            # pod slice: same mesh-invariant programs, but the score
+            # output comes back row-sharded across PROCESSES and must
+            # be resharded to fully-replicated before any host read
+            self._multihost = mesh_is_multihost(self.mesh)
             self._shard_spec = ShardSpec(
                 n_shards=d, axis=SHARD_AXIS, global_rows=self.num_data,
                 draw_npad=_ceil_to(max(self.num_data, _CHUNK), _CHUNK),
@@ -1484,6 +1479,10 @@ class DeviceGrower:
             self._row_pad = total_rows - self.num_data
             obs.set_gauge("shard.devices", d)
             obs.set_gauge("shard.local_rows", int(self.programs.n_pad))
+            if self._multihost:
+                import jax as _jax
+                obs.set_gauge("shard.hosts",
+                              int(_jax.process_count()))
             self._upload_binned(dataset, total_rows - self.num_data)
             self.meta = FeatureMeta.from_dataset(dataset, slot_stride=nb)
             self.hyper = SplitHyper.from_config(config)
@@ -1491,6 +1490,7 @@ class DeviceGrower:
             self.lr = float(config.learning_rate)
             return
         self._shard_spec = None
+        self._multihost = False
         bucket = self.num_data
         if row_bucketing and not quant_on:
             bucket = bucket_size(max(self.num_data, 1))
@@ -1536,6 +1536,9 @@ class DeviceGrower:
         ascontiguousarray pass — ~seconds at 10M rows).  Sharded, both
         layouts are placed row-split over the mesh axis so each device
         holds ONLY its shard's rows."""
+        if self._multihost:
+            self._upload_binned_multihost(dataset)
+            return
         if getattr(dataset, "device_binned", False):
             # matrix already lives in HBM (construct_from_device_matrix)
             binned_d = dataset.binned
@@ -1560,6 +1563,49 @@ class DeviceGrower:
                 NamedSharding(self.mesh, P(None, axis)))
         else:
             self.binned_t = jnp.transpose(self.binned)
+
+    def _upload_binned_multihost(self, dataset):
+        """Pod-slice upload: each process contributes ONLY its own
+        contiguous padded row block via
+        ``make_array_from_process_local_data`` — no host ever
+        materializes (or ships) the global matrix.  Two sources:
+
+        * a host-sharded dataset from the streaming multihost loader
+          (``dataset.host_shard``): ``dataset.binned`` IS the local
+          padded block, validated against the mesh's row span;
+        * a replicated dataset (every process constructed the full
+          matrix, e.g. the test path): slice this process's block out.
+        """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..utils.log import LightGBMError
+        from .shard import process_row_span, transpose_col_sharded
+        spec = self._shard_spec
+        n_pad = int(self.programs.n_pad)
+        lo, hi = process_row_span(self.mesh, n_pad)
+        if getattr(dataset, "host_shard", False):
+            local = np.ascontiguousarray(dataset.binned)
+            span = getattr(dataset, "host_row_span", None)
+            if span is not None and tuple(span) != (lo, hi):
+                raise LightGBMError(
+                    f"host-sharded dataset covers padded rows {span} "
+                    f"but this process's mesh block is ({lo}, {hi}) — "
+                    f"the loader and the grower disagree on the pod "
+                    f"layout (num_hosts/devices or bucket drift)")
+            if local.shape[0] != hi - lo:
+                raise LightGBMError(
+                    f"host-sharded binned block has {local.shape[0]} "
+                    f"rows, mesh block needs {hi - lo}")
+        else:
+            full = np.asarray(dataset.binned)
+            total = spec.n_shards * n_pad
+            if full.shape[0] < total:
+                full = np.pad(full, ((0, total - full.shape[0]),
+                                     (0, 0)))
+            local = np.ascontiguousarray(full[lo:hi])
+        self.binned = jax.make_array_from_process_local_data(
+            NamedSharding(self.mesh, P(spec.axis, None)), local)
+        self.binned_t = transpose_col_sharded(
+            self.mesh, spec.axis)(self.binned)
 
     # programs hold every static/trace-level attribute (hist_cols,
     # wave_width, stage_plan, nb, n_pad, quant_bits, feature_mask_for,
@@ -1631,6 +1677,13 @@ class DeviceGrower:
                 self.binned, self.binned_t, score, grad, hess,
                 feature_mask, jnp.asarray(lr, jnp.float32), row_mask, ti,
                 self._num_valid, self.meta, self.hyper, self.tables)
+        if self._multihost:
+            # the fused program's score comes back row-sharded across
+            # processes; reshard to fully-replicated so the host-side
+            # slice/flush below (and the caller's np.asarray) work
+            from .shard import replicate_to_all
+            out = (replicate_to_all(self.mesh)(out[0]),) + tuple(
+                out[1:])
         if self._row_pad:
             out = (out[0][:self.num_data],) + tuple(out[1:])
         return out
@@ -1661,6 +1714,11 @@ class DeviceGrower:
         kernel_tag = self.programs.hist_kernel_tag
         sharded = self.programs.shard is not None
         fused_find = self.programs.fused_find
+        if self._multihost:
+            from .shard import replicate_to_all
+            replicate = replicate_to_all(self.mesh)
+        else:
+            replicate = None
 
         def run(binned, binned_t, score, lr, gargs, it0, grad_fn):
             obs.inc(f"grow.hist.{kernel_tag}")
@@ -1679,6 +1737,11 @@ class DeviceGrower:
             final_score, recs = raw(binned, binned_t, score, lr, gargs,
                                     it0, num_valid, meta, hyper, tables,
                                     grad_fn=grad_fn)
+            if replicate is not None:
+                # pod slice: score returns row-sharded across hosts;
+                # every host needs the full vector for the next
+                # dispatch's pad, checkpoints and metrics
+                final_score = replicate(final_score)
             if row_pad:
                 final_score = final_score[:real_n]
             return final_score, recs

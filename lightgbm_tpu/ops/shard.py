@@ -60,7 +60,9 @@ Determinism / byte-identity contract (docs/Sharding.md)
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional
+import os
+import threading
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import numpy as np
@@ -69,6 +71,13 @@ from ..utils.log import LightGBMError, log_info
 
 #: the one mesh axis the sharded grower reduces over
 SHARD_AXIS = "shards"
+
+#: env fallbacks for the multi-controller bring-up params (one process
+#: per host cannot share a config file edit per rank, so rank/host
+#: count usually travel through the launcher's environment)
+ENV_COORDINATOR = "LGBM_TPU_COORDINATOR"
+ENV_NUM_HOSTS = "LGBM_TPU_NUM_HOSTS"
+ENV_HOST_RANK = "LGBM_TPU_HOST_RANK"
 
 
 def shard_map_compat(fn, mesh, in_specs, out_specs):
@@ -167,10 +176,281 @@ def sharding_mode(config) -> str:
     return str(getattr(config, "data_sharding", "off") or "off").lower()
 
 
+# ---------------------------------------------------------------------------
+# multi-controller (pod-slice) bring-up
+# ---------------------------------------------------------------------------
+
+def multihost_params(config=None) -> Optional[Tuple[str, int, int]]:
+    """Resolve ``(coordinator_address, num_hosts, host_rank)`` from the
+    config with ``LGBM_TPU_COORDINATOR`` / ``LGBM_TPU_NUM_HOSTS`` /
+    ``LGBM_TPU_HOST_RANK`` env fallbacks.
+
+    Returns None when none of the three is set anywhere (multi-
+    controller simply not configured); raises :class:`LightGBMError`
+    when the triple is only partially specified or malformed — a pod
+    host guessing its rank would train a silently-wrong model.
+    """
+    coord = str(getattr(config, "coordinator_address", "") or ""
+                ) or os.environ.get(ENV_COORDINATOR, "")
+    hosts_raw = getattr(config, "num_hosts", 0) or 0
+    hosts = int(hosts_raw) or int(os.environ.get(ENV_NUM_HOSTS, "0")
+                                  or "0")
+    rank_raw = getattr(config, "host_rank", -1)
+    rank = int(-1 if rank_raw is None else rank_raw)
+    if rank < 0:
+        rank = int(os.environ.get(ENV_HOST_RANK, "-1") or "-1")
+    if not coord and hosts <= 0 and rank < 0:
+        return None
+    if not coord or hosts <= 0 or rank < 0:
+        raise LightGBMError(
+            f"data_sharding=multi_controller needs ALL of "
+            f"coordinator_address/num_hosts/host_rank (or the "
+            f"{ENV_COORDINATOR}/{ENV_NUM_HOSTS}/{ENV_HOST_RANK} env "
+            f"vars); resolved coordinator={coord!r} num_hosts={hosts} "
+            f"host_rank={rank}")
+    if rank >= hosts:
+        raise LightGBMError(
+            f"host_rank={rank} out of range for num_hosts={hosts}")
+    if ":" not in coord:
+        raise LightGBMError(
+            f"coordinator_address must be host:port, got {coord!r}")
+    return coord, hosts, rank
+
+
+def _distributed_client_active() -> bool:
+    """Whether ``jax.distributed.initialize`` already ran in this
+    process — checked WITHOUT touching ``jax.devices()`` (which would
+    initialize the backend pre-coordinator and wedge the bring-up)."""
+    try:
+        from jax._src import distributed as _jdist
+        return getattr(_jdist.global_state, "client", None) is not None
+    except Exception:   # noqa: BLE001 — private-API drift: assume cold
+        return False
+
+
+def multihost_setup(config=None) -> Tuple[int, int]:
+    """Fail-fast ``jax.distributed`` bring-up for one pod-slice host.
+
+    Returns ``(host_rank, num_hosts)``.  Idempotent: a process whose
+    distributed client is already up just reports its rank.  Rank 0
+    hosts the coordinator and initializes directly; ranks > 0 first
+    probe the coordinator socket with :func:`~lightgbm_tpu.parallel.
+    network.wait_for_peer` (honoring ``network_timeout`` /
+    ``network_retries``) so a dead coordinator surfaces as the
+    familiar "peer unreachable after N attempts" error instead of a
+    multi-minute initialize hang.  On CPU the cross-process collective
+    backend is pinned to gloo BEFORE initialize — without it every
+    psum dies with "Multiprocess computations aren't implemented on
+    the CPU backend".
+    """
+    from .. import obs
+    if _distributed_client_active():
+        rank = int(jax.process_index())
+        hosts = int(jax.process_count())
+        obs.set_gauge("shard.hosts", hosts)
+        return rank, hosts
+    resolved = multihost_params(config)
+    if resolved is None:
+        raise LightGBMError(
+            "data_sharding=multi_controller: no coordinator configured "
+            "— set coordinator_address/num_hosts/host_rank (or the "
+            "LGBM_TPU_COORDINATOR/LGBM_TPU_NUM_HOSTS/"
+            "LGBM_TPU_HOST_RANK env vars)")
+    coord, hosts, rank = resolved
+    try:
+        # scoped to the CPU backend; a no-op for TPU pods
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:   # noqa: BLE001 — option absent on this jax
+        pass
+    if rank > 0:
+        # fail fast with peer context before the (slow) initialize
+        # handshake; the probe retries with the shared backoff policy
+        from ..parallel.network import wait_for_peer
+        wait_for_peer(coord, config=config)
+    from ..parallel.network import network_policy_from_config
+    attempts, timeout_s = network_policy_from_config(config)
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coord, num_processes=hosts,
+            process_id=rank,
+            initialization_timeout=max(10, int(attempts * timeout_s)))
+    except Exception as e:   # noqa: BLE001 — any bring-up failure
+        raise LightGBMError(
+            f"jax.distributed bring-up failed for host {rank}/{hosts} "
+            f"against coordinator {coord}: {type(e).__name__}: {e}")
+    got = int(jax.process_count())
+    if got != hosts:
+        raise LightGBMError(
+            f"pod bring-up inconsistent: num_hosts={hosts} configured "
+            f"but jax.process_count()={got}")
+    obs.set_gauge("shard.hosts", hosts)
+    log_info(f"multi_controller: host {rank}/{hosts} up against "
+             f"{coord}, {len(jax.devices())} global device(s)")
+    return rank, hosts
+
+
+def is_multihost() -> bool:
+    """True when this process is part of an initialized multi-process
+    runtime (safe to call pre-bring-up: never initializes jax)."""
+    if not _distributed_client_active():
+        return False
+    try:
+        return int(jax.process_count()) > 1
+    except Exception:   # noqa: BLE001
+        return False
+
+
+def mesh_is_multihost(mesh) -> bool:
+    """Whether a mesh spans more than one process."""
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
+def make_pod_mesh():
+    """One-axis ``SHARD_AXIS`` mesh over ALL global devices, sorted by
+    ``(process_index, device id)`` so each host's addressable devices
+    form one CONTIGUOUS run of mesh positions — the invariant that
+    makes a host's row block ``[first_dev * n_loc, (last_dev+1) *
+    n_loc)`` contiguous in the global padded row space (and therefore
+    loadable as one streamed slab)."""
+    from jax.sharding import Mesh
+    devices = sorted(jax.devices(),
+                     key=lambda d: (int(d.process_index), int(d.id)))
+    if len(devices) < 2:
+        raise LightGBMError(
+            f"data_sharding=multi_controller needs >= 2 global "
+            f"devices, have {len(devices)}")
+    return Mesh(np.asarray(devices), (SHARD_AXIS,))
+
+
+def process_row_span(mesh, local_rows: int,
+                     process_index: Optional[int] = None
+                     ) -> Tuple[int, int]:
+    """``[lo, hi)`` block of the global PADDED row space owned by one
+    process under a pod mesh with ``local_rows`` rows per device."""
+    pid = (int(jax.process_index()) if process_index is None
+           else int(process_index))
+    idx = [i for i, d in enumerate(mesh.devices.flat)
+           if int(d.process_index) == pid]
+    if not idx:
+        raise LightGBMError(
+            f"process {pid} owns no devices of the pod mesh")
+    if idx != list(range(idx[0], idx[0] + len(idx))):
+        raise LightGBMError(
+            f"pod mesh devices of process {pid} are not contiguous "
+            f"(mesh positions {idx}); build the mesh with "
+            f"make_pod_mesh()")
+    return idx[0] * int(local_rows), (idx[-1] + 1) * int(local_rows)
+
+
+def shard_local_rows(num_data: int, n_shards: int, config,
+                     row_bucketing: Optional[bool] = None) -> int:
+    """Per-device padded row count for a ``num_data``-row dataset over
+    ``n_shards`` devices: ``ceil(N/D)`` lifted onto the pow2 bucket
+    ladder (unless quantization keys its rounding stream on the exact
+    padded shape, or the bucket would cross the striped-count bound),
+    then chunk-aligned.  Factored out of the grower so ingest code can
+    compute a host's row block BEFORE the grower exists — the padded
+    layout is part of the data contract, not a grower detail."""
+    from .grow import _CHUNK, _ceil_to, COUNT_SPLIT_ROWS
+    from .histogram import bucket_size
+    if row_bucketing is None:
+        row_bucketing = bool(getattr(config, "train_row_bucketing",
+                                     True))
+    quant_on = bool(int(getattr(config, "grad_quant_bits", 0) or 0))
+    srows = -(-int(num_data) // int(n_shards))
+    if row_bucketing and not quant_on:
+        b = bucket_size(max(srows, 1))
+        if b >= 2 * COUNT_SPLIT_ROWS:
+            log_info(
+                f"train_row_bucketing: per-shard bucket {b} would "
+                f"reach the striped-count bound; using exact "
+                f"per-shard rows ({srows})")
+        else:
+            srows = b
+    return _ceil_to(max(srows, _CHUNK), _CHUNK)
+
+
+# replicate-to-all programs keyed by mesh device ids: ONE compiled
+# identity per mesh, reused across growers/windows so warm same-shape
+# windows re-dispatch instead of re-tracing (obs.track_jit makes any
+# violation visible to the zero-retrace gates)
+_REPLICATE_CACHE: dict = {}
+_TRANSPOSE_CACHE: dict = {}
+_PROGRAM_CACHE_LOCK = threading.Lock()
+
+
+def replicate_to_all(mesh):
+    """Jitted identity resharding any array to fully-replicated over
+    ``mesh``.  Multi-controller growers apply it to the row-sharded
+    final score so every host holds the full vector (checkpoints,
+    metrics and the next dispatch all read it host-side); on a
+    single-process mesh the arrays are already fully addressable and
+    callers skip this entirely."""
+    key = tuple(int(d.id) for d in mesh.devices.flat)
+    fn = _REPLICATE_CACHE.get(key)
+    if fn is None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from .. import obs
+        fn = obs.track_jit(
+            "shard.replicate",
+            jax.jit(lambda x: x,
+                    out_shardings=NamedSharding(mesh, P())))
+        with _PROGRAM_CACHE_LOCK:
+            fn = _REPLICATE_CACHE.setdefault(key, fn)
+    return fn
+
+
+def transpose_col_sharded(mesh, axis: str = SHARD_AXIS):
+    """Jitted ``(N, G) -> (G, N)`` transpose whose output is pinned
+    column-split over the mesh axis — the multi-controller equivalent
+    of the single-process ``device_put`` placement (``device_put``
+    cannot reshard an array it cannot fully address; an SPMD program
+    with explicit ``out_shardings`` can)."""
+    key = (tuple(int(d.id) for d in mesh.devices.flat), axis)
+    fn = _TRANSPOSE_CACHE.get(key)
+    if fn is None:
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from .. import obs
+        fn = obs.track_jit(
+            "shard.binned_t",
+            jax.jit(lambda x: jnp.transpose(x),
+                    out_shardings=NamedSharding(mesh, P(None, axis))))
+        with _PROGRAM_CACHE_LOCK:
+            fn = _TRANSPOSE_CACHE.setdefault(key, fn)
+    return fn
+
+
+def host_replicated(mesh, value):
+    """Place host-identical data fully-replicated on every device of a
+    (possibly multi-process) mesh.  Every process must call this with
+    the SAME value — it is the caller's broadcast contract (mappers
+    and labels travel over the net.broadcast blob plane first)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sh = NamedSharding(mesh, P())
+    arr = np.asarray(value)
+    return jax.make_array_from_process_local_data(sh, arr)
+
+
 def resolve_shard_mesh(config) -> Optional[object]:
-    """Mesh for ``data_sharding=single_controller``, or None (off /
-    not enough devices — logged, training proceeds unsharded)."""
-    if sharding_mode(config) != "single_controller":
+    """Mesh for the configured ``data_sharding`` mode, or None.
+
+    ``single_controller`` degrades gracefully (logged, training
+    proceeds unsharded) — it is a local optimization.  A
+    ``multi_controller`` failure RAISES instead: one pod host silently
+    falling back to unsharded training while its peers wait on the
+    histogram psum would wedge the whole slice, so bring-up errors
+    must kill the process loudly.
+    """
+    mode = sharding_mode(config)
+    if mode == "multi_controller":
+        rank, hosts = multihost_setup(config)
+        mesh = make_pod_mesh()
+        log_info(f"data_sharding=multi_controller: host {rank}/{hosts}"
+                 f", row-sharding over {mesh.devices.size} global "
+                 f"device(s), psum wave histograms")
+        return mesh
+    if mode != "single_controller":
         return None
     try:
         mesh = make_shard_mesh(int(getattr(config, "shard_devices", 0)

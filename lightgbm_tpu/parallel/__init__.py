@@ -18,6 +18,16 @@ def create_tree_learner(config, dataset, mesh=None):
     kind = config.tree_learner
     if kind not in ("serial", "feature", "data", "voting"):
         raise LightGBMError(f"unknown tree_learner: {kind}")
+    from ..ops.shard import sharding_mode
+    if (kind != "serial" and int(config.num_machines) > 1
+            and sharding_mode(config) == "multi_controller"):
+        # the machine-parallel learners drive their own socket network
+        # per worker; mixing that with a pod-slice jax.distributed
+        # runtime would double-shard the rows and deadlock both planes
+        raise LightGBMError(
+            f"tree_learner={kind} cannot be combined with "
+            f"data_sharding=multi_controller (the pod slice IS the "
+            f"data-parallel plane); use tree_learner=serial")
     if int(config.num_machines) <= 1 and mesh is None:
         if kind != "serial":
             log_warning(

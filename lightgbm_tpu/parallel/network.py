@@ -358,6 +358,105 @@ def network_policy_from_config(config):
                           DEFAULT_NETWORK_TIMEOUT_S)))
 
 
+# ---------------------------------------------------------------------------
+# pod-slice blob broadcast (rank 0 -> every peer)
+# ---------------------------------------------------------------------------
+# jax.distributed has no host-payload channel, and the mapper reference
+# a pod host needs BEFORE it can bin its shard cannot ride a device
+# collective (the mesh does not exist yet).  So the multi-controller
+# ingest handshake reuses the length-prefixed blob plane above: rank 0
+# serves the serialized payload on ``coordinator port + 1``, every peer
+# dials it with the same retry/timeout policy as the coordinator probe.
+# Rounds are SPMD-sequenced — every process calls broadcast_blob the
+# same number of times in the same order — so one well-known port
+# serves any number of sequential rounds.
+
+#: offset from the jax.distributed coordinator port to the blob
+#: broadcast port (the coordinator owns its own port on rank 0)
+BROADCAST_PORT_OFFSET = 1
+
+
+def pod_broadcast_address(coordinator_address: str) -> str:
+    """``host:port`` of the blob broadcast endpoint derived from the
+    coordinator address."""
+    host, _, port = str(coordinator_address).rpartition(":")
+    if not host or not port.isdigit():
+        raise LightGBMError(
+            f"bad coordinator address {coordinator_address!r} "
+            f"(expected host:port)")
+    return f"{host}:{int(port) + BROADCAST_PORT_OFFSET}"
+
+
+def broadcast_blob(payload: Optional[bytes], *, address: str,
+                   num_hosts: int, rank: int, config=None) -> bytes:
+    """One broadcast round: rank 0 sends ``payload`` to every peer and
+    returns it; peers pass ``payload=None`` and return the received
+    bytes.  Fail-fast on both sides: rank 0 bounds the accept loop by
+    the ``network_timeout``-derived deadline and names the ranks that
+    never dialed in; peers ride ``connect_with_retries`` so a dead
+    rank 0 surfaces as "peer unreachable after N attempts"."""
+    faults.check("net.broadcast")
+    attempts, timeout_s = network_policy_from_config(config)
+    host, _, port = str(address).rpartition(":")
+    if not host or not port.isdigit():
+        raise LightGBMError(
+            f"bad broadcast address {address!r} (expected host:port)")
+    port = int(port)
+    num_hosts = int(num_hosts)
+    if int(rank) != 0:
+        sock = connect_with_retries(host, port, config=config)
+        try:
+            send_bytes(sock, struct.pack("<i", int(rank)),
+                       timeout_s=timeout_s)
+            blob = recv_bytes(sock, timeout_s=timeout_s)
+        finally:
+            sock.close()
+        obs.inc("net.broadcast_bytes", len(blob))
+        return blob
+    if payload is None:
+        raise LightGBMError("broadcast_blob: rank 0 must supply the "
+                            "payload")
+    deadline = time.monotonic() + max(10.0, attempts * timeout_s)
+    server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    pending = set(range(1, num_hosts))
+    try:
+        try:
+            # peers dial the coordinator hostname; rank 0 accepts on
+            # every interface so "localhost" vs the public name both
+            # land here
+            server.bind(("", port))
+        except OSError as e:
+            raise LightGBMError(
+                f"broadcast endpoint {address} unavailable on host 0: "
+                f"{e}") from e
+        server.listen(max(num_hosts, 1))
+        while pending:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise LightGBMError(
+                    f"pod broadcast on {address}: host(s) "
+                    f"{sorted(pending)} never connected within the "
+                    f"network_timeout budget — peer dead at ingest "
+                    f"bring-up")
+            server.settimeout(min(remaining, 1.0))
+            try:
+                conn, _addr = server.accept()
+            except socket.timeout:
+                continue
+            try:
+                (peer_rank,) = struct.unpack(
+                    "<i", recv_bytes(conn, timeout_s=timeout_s))
+                send_bytes(conn, payload, timeout_s=timeout_s)
+            finally:
+                conn.close()
+            pending.discard(peer_rank)
+    finally:
+        server.close()
+    obs.inc("net.broadcast_bytes", len(payload))
+    return payload
+
+
 @functools.lru_cache(maxsize=8)
 def _default_network(num_machines: int) -> Network:
     log_info(f"Initializing TPU collective mesh with {num_machines} "

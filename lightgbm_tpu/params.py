@@ -512,6 +512,26 @@ PARAM_SCHEMA: Sequence[Param] = (
             "point-to-point helpers, with capped exponential backoff "
             "between attempts; exhausting them raises 'peer unreachable "
             "after N attempts' instead of hanging", section="network"),
+    _p("coordinator_address", str, "", (),
+       desc="host:port of the jax.distributed coordinator for "
+            "data_sharding=multi_controller (docs/Sharding.md): rank 0 "
+            "hosts it, every rank dials it during bring-up. Empty = "
+            "read the LGBM_TPU_COORDINATOR env var (launchers usually "
+            "set the env triple instead of editing per-host configs). "
+            "All three of coordinator_address/num_hosts/host_rank must "
+            "resolve or bring-up fails fast", section="network"),
+    _p("num_hosts", int, 0, (), check=">= 0",
+       desc="process count of the multi_controller pod slice (one "
+            "process per host). 0 = read LGBM_TPU_NUM_HOSTS. Bring-up "
+            "verifies jax.process_count() matches and fails fast on "
+            "mismatch", section="network"),
+    _p("host_rank", int, -1, (), check=">= -1",
+       desc="this process's rank in [0, num_hosts) for "
+            "multi_controller; rank 0 hosts the coordinator, runs "
+            "streaming round 1 (count + reservoir + find-bin), "
+            "broadcasts the BinMapper reference, and owns the pod "
+            "checkpoint manifest. -1 = read LGBM_TPU_HOST_RANK",
+       section="network"),
 
     # -- device -----------------------------------------------------------
     _p("gpu_platform_id", int, -1, (), desc="compat; ignored", section="device"),
@@ -662,10 +682,10 @@ PARAM_SCHEMA: Sequence[Param] = (
             "(datasets over 2^24 rows fall back to exact rows, logged). "
             "See docs/ColdStart.md", section="device"),
     _p("data_sharding", str, "off", (),
-       check="off/single_controller",
-       desc="single-controller data-parallel training for the device "
-            "grower (docs/Sharding.md): single_controller row-shards "
-            "the binned matrix and every per-row buffer across a local "
+       check="off/single_controller/multi_controller",
+       desc="data-parallel training for the device grower "
+            "(docs/Sharding.md): single_controller row-shards the "
+            "binned matrix and every per-row buffer across a local "
             "device mesh with shard_map from ONE process, runs the "
             "fused K-trees-per-dispatch scan on all chips, and "
             "psum-reduces the wave histograms over the mesh axis as "
@@ -675,9 +695,17 @@ PARAM_SCHEMA: Sequence[Param] = (
             "scan, models are BYTE-identical to the single-device "
             "fused path; f32 histograms are bit-reproducible "
             "run-to-run. Falls back (logged) to unsharded training "
-            "with fewer than 2 devices. off (default) = unsharded; the "
+            "with fewer than 2 devices. multi_controller extends the "
+            "same program to a pod slice: N processes (one per host) "
+            "initialize jax.distributed against coordinator_address/"
+            "num_hosts/host_rank, build ONE global mesh, and run the "
+            "identical fused scan — program signatures are "
+            "mesh-invariant, so a pod run is byte-identical to "
+            "single_controller under the int32 quant scan; bring-up "
+            "failures RAISE (a host silently falling back would wedge "
+            "the slice on the psum). off (default) = unsharded; the "
             "multiprocess tree_learner=data/feature/voting mesh remains "
-            "the multi-host fallback", section="device"),
+            "the socket-level fallback", section="device"),
     _p("shard_devices", int, 0, (), check=">= 0",
        desc="device count for data_sharding=single_controller: the "
             "first N local devices form the one-axis mesh; 0 (default) "

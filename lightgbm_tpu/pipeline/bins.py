@@ -172,22 +172,9 @@ class BinMapperCache:
     def save(self, path: str) -> None:
         if self.reference is None:
             raise LightGBMError("BinMapperCache has no reference to save")
-        ref = self.reference
-        state = {
-            "num_total_features": ref.num_total_features,
-            "feature_names": ref.feature_names,
-            "used_features": ref.used_features,
-            "mappers": [m.to_state() if m else None
-                        for m in ref.bin_mappers],
-            "groups": [g.feature_indices for g in ref.groups],
-            "occ": self._ref_occ,
-            "occ_n": self._ref_n,
-            "drift_threshold": self.drift_threshold,
-            # adopted verbatim by reference-constructed datasets —
-            # a restarted pipeline must keep training constrained
-            "monotone": np.asarray(ref.monotone_constraints),
-            "penalty": np.asarray(ref.feature_penalty),
-        }
+        state = _reference_state(self.reference)
+        state.update(occ=self._ref_occ, occ_n=self._ref_n,
+                     drift_threshold=self.drift_threshold)
         with open(path, "wb") as fh:
             fh.write(CACHE_MAGIC)
             pickle.dump(state, fh, protocol=4)
@@ -203,22 +190,115 @@ class BinMapperCache:
             state = pickle.load(fh)
         cache = cls(drift_threshold=float(state["drift_threshold"]),
                     rebin_on_drift=rebin_on_drift)
-        # a data-free skeleton dataset carries the mappers/groups; it is
-        # only ever used as a `reference=`, which reads exactly these
-        ref = BinnedDataset()
-        ref.num_total_features = int(state["num_total_features"])
-        ref.feature_names = list(state["feature_names"])
-        ref.used_features = list(state["used_features"])
-        ref.bin_mappers = [BinMapper.from_state(s) if s else None
-                           for s in state["mappers"]]
-        ref.groups = [FeatureGroupInfo(g, [ref.bin_mappers[f] for f in g])
-                      for g in state["groups"]]
-        ref._build_feature_lookups(None)
-        # restore what _build_feature_lookups(None) cannot know
-        ref.monotone_constraints = np.asarray(state["monotone"],
-                                              np.int32)
-        ref.feature_penalty = np.asarray(state["penalty"], np.float64)
-        cache.reference = ref
+        cache.reference = _skeleton_from_state(state)
         cache._ref_occ = np.asarray(state["occ"], np.float64)
         cache._ref_n = int(state["occ_n"])
         return cache
+
+
+# ---------------------------------------------------------------------------
+# reference serialization + the pod-slice mapper broadcast
+# ---------------------------------------------------------------------------
+# A multi-controller pod host must bin its row shard against EXACTLY
+# the layout host 0's find-bin produced — a peer running its own
+# find-bin over a different sample would disagree on bin boundaries
+# AND on feature bundling, changing the program signature and the
+# trees.  So the layout travels as a self-contained blob (the same
+# state dict BinMapperCache persists, minus the drift bookkeeping)
+# over the network.py broadcast plane, and peers rebuild a data-free
+# skeleton that construct_streaming_begin adopts ``reference=``-style.
+
+def _reference_state(ref: BinnedDataset) -> dict:
+    """The picklable mapper/group/constraint layout of a dataset (no
+    row data) — the unit both the on-disk cache and the pod broadcast
+    serialize."""
+    return {
+        "num_total_features": ref.num_total_features,
+        "feature_names": ref.feature_names,
+        "used_features": ref.used_features,
+        "mappers": [m.to_state() if m else None
+                    for m in ref.bin_mappers],
+        "groups": [g.feature_indices for g in ref.groups],
+        # adopted verbatim by reference-constructed datasets —
+        # a restarted pipeline must keep training constrained
+        "monotone": np.asarray(ref.monotone_constraints),
+        "penalty": np.asarray(ref.feature_penalty),
+    }
+
+
+def _skeleton_from_state(state: dict) -> BinnedDataset:
+    """A data-free skeleton dataset carrying the mappers/groups; only
+    ever used as a ``reference=``, which reads exactly these."""
+    ref = BinnedDataset()
+    ref.num_total_features = int(state["num_total_features"])
+    ref.feature_names = list(state["feature_names"])
+    ref.used_features = list(state["used_features"])
+    ref.bin_mappers = [BinMapper.from_state(s) if s else None
+                       for s in state["mappers"]]
+    ref.groups = [FeatureGroupInfo(g, [ref.bin_mappers[f] for f in g])
+                  for g in state["groups"]]
+    ref._build_feature_lookups(None)
+    # restore what _build_feature_lookups(None) cannot know
+    ref.monotone_constraints = np.asarray(state["monotone"], np.int32)
+    ref.feature_penalty = np.asarray(state["penalty"], np.float64)
+    return ref
+
+
+def reference_to_bytes(ref: BinnedDataset,
+                       extra: Optional[dict] = None) -> bytes:
+    """Serialize a dataset's mapper/group layout (plus a small
+    picklable ``extra`` dict of handshake facts — global row count,
+    column count) to a self-contained blob."""
+    state = _reference_state(ref)
+    state["extra"] = dict(extra or {})
+    return CACHE_MAGIC + pickle.dumps(state, protocol=4)
+
+
+def reference_from_bytes(blob: bytes
+                         ) -> Tuple[BinnedDataset, dict]:
+    """Rebuild ``(skeleton, extra)`` from :func:`reference_to_bytes`
+    output."""
+    if not blob.startswith(CACHE_MAGIC):
+        raise LightGBMError(
+            "broadcast blob is not a lightgbm_tpu mapper reference "
+            "(magic mismatch) — coordinator/broadcast port collision?")
+    state = pickle.loads(blob[len(CACHE_MAGIC):])
+    return _skeleton_from_state(state), dict(state.get("extra") or {})
+
+
+def reference_layout_digest(ref: BinnedDataset) -> str:
+    """Digest of the mapper/group layout — equal across pod hosts iff
+    they will trace identical program signatures and bin rows
+    identically (tests/test_multihost.py pins this)."""
+    import hashlib
+    state = _reference_state(ref)
+    state.pop("penalty", None)
+    h = hashlib.sha256()
+    h.update(pickle.dumps(
+        [state["num_total_features"], state["used_features"],
+         state["groups"],
+         [s if s is None else sorted(s.items())
+          for s in state["mappers"]]], protocol=4))
+    return h.hexdigest()
+
+
+def broadcast_reference(reference: Optional[BinnedDataset], *,
+                        address: str, num_hosts: int, rank: int,
+                        config=None, extra: Optional[dict] = None
+                        ) -> Tuple[BinnedDataset, dict]:
+    """The pod ingest handshake: host 0 broadcasts its freshly-found
+    reference layout (+ ``extra`` handshake facts), peers return the
+    reconstructed skeleton.  Every host comes back with an equal
+    layout digest or construction would diverge."""
+    from ..parallel.network import broadcast_blob
+    payload = None
+    if int(rank) == 0:
+        if reference is None:
+            raise LightGBMError(
+                "broadcast_reference: host 0 must supply the reference")
+        payload = reference_to_bytes(reference, extra)
+    blob = broadcast_blob(payload, address=address,
+                          num_hosts=num_hosts, rank=rank, config=config)
+    if int(rank) == 0:
+        return reference, dict(extra or {})
+    return reference_from_bytes(blob)

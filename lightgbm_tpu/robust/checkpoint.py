@@ -143,6 +143,149 @@ def latest_snapshot(output_model: str) -> Optional[str]:
 
 
 # ---------------------------------------------------------------------------
+# pod-slice commit protocol (data_sharding=multi_controller)
+# ---------------------------------------------------------------------------
+#
+# A pod checkpoint is only real once EVERY host has materialized its
+# state: host 0 must not publish a snapshot a dead peer never reached,
+# or resume would silently diverge.  The protocol (docs/Sharding.md):
+#
+#   1. every host writes an atomic ack file ``<path>.ack.h<rank>``
+#      carrying a digest of its view of the snapshot state (model trees
+#      + scores + iteration — byte-identical across hosts by the
+#      sharding contract, so the digest doubles as a divergence check);
+#   2. host 0 polls for all acks (network_timeout-derived deadline) and
+#      verifies every digest matches its own;
+#   3. host 0 writes the payload (model text, .state.npz sidecar) and
+#      THEN the ``<path>.commit`` marker — the commit point;
+#   4. peers poll for a marker with the matching digest before
+#      returning, so no host proceeds past an uncommitted snapshot.
+#
+# A host killed mid-window never acks, host 0 times out, no marker
+# lands, and the pod resumes from the previous committed snapshot.
+# The ``ckpt.ack`` fault site arms step 1 for LGBM_TPU_FAULTS chaos.
+
+_POLL_INTERVAL_S = 0.05
+
+
+def _pod_ack_path(path: str, rank: int) -> str:
+    return f"{path}.ack.h{int(rank)}"
+
+
+def pod_commit_path(path: str) -> str:
+    return f"{path}.commit"
+
+
+def pod_state_digest(model_trees: str, score: np.ndarray,
+                     iteration: int) -> str:
+    """Digest of one host's snapshot view.  Callers pass the model text
+    WITHOUT the parameters echo (``host_rank`` legitimately differs per
+    host); scores and iteration are byte-identical across hosts under
+    the replicated-score contract."""
+    import hashlib
+    h = hashlib.sha256()
+    h.update(model_trees.encode())
+    h.update(np.ascontiguousarray(score, np.float32).tobytes())
+    h.update(str(int(iteration)).encode())
+    return h.hexdigest()
+
+
+def write_pod_ack(path: str, rank: int, digest: str) -> None:
+    """Atomically publish this host's readiness for the snapshot at
+    ``path`` (step 1 of the pod commit protocol)."""
+    faults.check("ckpt.ack")
+    atomic_write_text(_pod_ack_path(path, rank),
+                      json.dumps({"rank": int(rank), "digest": digest}))
+
+
+def await_pod_acks(path: str, num_hosts: int, digest: str,
+                   timeout_s: float, sleep=None) -> None:
+    """Host 0: block until every host's ack lands with a matching
+    digest; raises :class:`LightGBMError` naming the missing ranks on
+    timeout and the diverging rank on digest mismatch."""
+    import time
+    sleep = sleep or time.sleep
+    deadline = time.monotonic() + float(timeout_s)
+    missing = list(range(int(num_hosts)))
+    while True:
+        still = []
+        for rank in missing:
+            ack = _pod_ack_path(path, rank)
+            if not os.path.exists(ack):
+                still.append(rank)
+                continue
+            try:
+                with open(ack) as fh:
+                    got = json.load(fh)
+            except (OSError, ValueError):
+                still.append(rank)   # mid-replace read; retry
+                continue
+            if str(got.get("digest")) != digest:
+                raise LightGBMError(
+                    f"pod checkpoint {path}: host {rank} acked digest "
+                    f"{got.get('digest')!r} but host 0 computed "
+                    f"{digest!r} — pod state diverged, refusing to "
+                    f"commit")
+        missing = still
+        if not missing:
+            return
+        if time.monotonic() >= deadline:
+            raise LightGBMError(
+                f"pod checkpoint {path}: no ack from host(s) "
+                f"{missing} within {timeout_s:.1f}s — a peer died "
+                f"mid-window; snapshot NOT committed")
+        sleep(_POLL_INTERVAL_S)
+
+
+def commit_pod(path: str, digest: str) -> None:
+    """Step 3's commit point: the marker is written LAST, after every
+    payload file, so its presence certifies a complete snapshot."""
+    atomic_write_text(pod_commit_path(path),
+                      json.dumps({"digest": digest}))
+
+
+def await_pod_commit(path: str, digest: str, timeout_s: float,
+                     sleep=None) -> None:
+    """Peers: block until host 0's commit marker lands with the
+    matching digest (a stale marker from an earlier snapshot at the
+    same path keeps polling until the fresh one replaces it)."""
+    import time
+    sleep = sleep or time.sleep
+    deadline = time.monotonic() + float(timeout_s)
+    while True:
+        marker = pod_commit_path(path)
+        if os.path.exists(marker):
+            try:
+                with open(marker) as fh:
+                    got = json.load(fh)
+            except (OSError, ValueError):
+                got = {}
+            if str(got.get("digest")) == digest:
+                return
+        if time.monotonic() >= deadline:
+            raise LightGBMError(
+                f"pod checkpoint {path}: host 0 never committed "
+                f"within {timeout_s:.1f}s — snapshot abandoned")
+        sleep(_POLL_INTERVAL_S)
+
+
+def has_pod_commit(path: str) -> bool:
+    """Whether the snapshot at ``path`` was pod-committed (resume
+    pickers must skip uncommitted pod snapshots)."""
+    return os.path.exists(pod_commit_path(path))
+
+
+def clear_pod_acks(path: str, num_hosts: int) -> None:
+    """Best-effort ack cleanup after a commit (stale acks from an
+    earlier snapshot at the same path would short-circuit step 2)."""
+    for rank in range(int(num_hosts)):
+        try:
+            os.remove(_pod_ack_path(path, rank))
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
 # pipeline window checkpoints
 # ---------------------------------------------------------------------------
 

@@ -66,8 +66,9 @@ ENV_VAR = "LGBM_TPU_FAULTS"
 KNOWN_SITES = (
     "grow.dispatch", "serve.dispatch", "serve.fleet.dispatch",
     "pipeline.prep", "pipeline.train",
-    "net.connect", "net.send", "net.recv", "io.read", "io.write",
-    "stream.parse", "obs.export",
+    "net.connect", "net.send", "net.recv", "net.broadcast",
+    "io.read", "io.write",
+    "stream.parse", "obs.export", "ckpt.ack",
 )
 
 

@@ -151,6 +151,15 @@ if [[ "${1:-}" != "--fast" ]]; then
     #     (docs/Sharding.md)
     step "shard smoke" python scripts/check_shard.py
 
+    # 5f. multi-host smoke: a 2-process localhost jax.distributed
+    #     pod-slice run (data_sharding=multi_controller, one process
+    #     per host streaming its own row stripe) must train trees
+    #     byte-identical to the single-process single_controller run
+    #     on the same 4-device global mesh, trace nothing new on warm
+    #     windows on EVERY host, and fail fast against a dead
+    #     coordinator (docs/Sharding.md "Multi-host pod slices")
+    step "multihost smoke" python scripts/check_multihost.py
+
     tier1() {
         rm -f /tmp/_t1.log
         timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ \
