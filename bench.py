@@ -1450,6 +1450,42 @@ def run_cache_admission(args) -> dict:
     return result
 
 
+def run_soak(args) -> dict:
+    """``--suite soak``: the composed N-tenant CDN-fleet chaos soak
+    (lightgbm_tpu/soak, docs/Soak.md) — per-tenant windowed retrains
+    hot-swapping into a shared FleetServer under mixed-tenant query
+    load and the scenario's seed-keyed fault timeline, gated on the
+    SLO engine plus the harness invariants (resume byte-identity,
+    zero-retrace swaps, throughput vs the 125.4 s/20M reference).
+
+    The scenario comes from ``--soak-scenario`` (JSON file) or the
+    ``LGBM_TPU_SOAK`` env override; default is the CI smoke shape
+    (2 tenants x 3 windows x 1 kill)."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.soak import SoakScenario, run_and_report
+
+    path = getattr(args, "soak_scenario", "") or ""
+    if path and not os.environ.get("LGBM_TPU_SOAK", ""):
+        sc = SoakScenario.from_file(path)
+    else:
+        sc = SoakScenario.from_config(Config({}))
+    verdict = run_and_report(sc)
+    thr = verdict["gates"]["throughput"]
+    return {
+        "metric": "soak_train_s_per_1M_sampled_rows",
+        "value": thr["train_s_per_1M_sampled_rows"],
+        "unit": "s_per_1m_rows",
+        "reference_s_per_1M": thr["reference_s_per_1M"],
+        "ok": verdict["ok"],
+        "gates": {name: g["ok"]
+                  for name, g in verdict["gates"].items()},
+        "timeline_digest": verdict["timeline_digest"],
+        # non-TPU numbers validate the composition, not the chip
+        "chip_pending": verdict["chip_pending"],
+        "soak": verdict,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--rows", type=int,
@@ -1541,7 +1577,8 @@ def main() -> int:
                          "skipped where the profiler is unavailable)")
     ap.add_argument("--suite",
                     choices=["all", "higgs", "mslr", "cache", "serve",
-                             "coldstart", "quant", "shard", "explain"],
+                             "coldstart", "quant", "shard", "explain",
+                             "soak"],
                     default=os.environ.get("BENCH_SUITE", "all"),
                     help="all = HIGGS headline + MSLR lambdarank "
                          "(both north stars, BASELINE.md); cache = the "
@@ -1562,7 +1599,15 @@ def main() -> int:
                          "shard_scaling_efficiency, psum_ms_per_tree "
                          "and the byte-identity verdict (MULTICHIP_r06, "
                          "docs/Sharding.md); with --hosts N the suite "
-                         "runs the multi-process pod-slice legs instead")
+                         "runs the multi-process pod-slice legs "
+                         "instead; soak = the composed fleet chaos "
+                         "soak to an SLO-gated verdict (SOAK_r*, "
+                         "docs/Soak.md)")
+    ap.add_argument("--soak-scenario",
+                    default=os.environ.get("BENCH_SOAK_SCENARIO", ""),
+                    help="--suite soak: JSON SoakScenario file "
+                         "(docs/Soak.md); empty uses the CI smoke "
+                         "shape, LGBM_TPU_SOAK overrides")
     ap.add_argument("--compile-cache-dir",
                     default=os.environ.get(
                         "LGBM_TPU_COMPILE_CACHE",
@@ -1642,7 +1687,9 @@ def main() -> int:
         args.suite = "cache"
     if args.explain:
         args.suite = "explain"
-    if args.suite == "explain":
+    if args.suite == "soak":
+        result = run_soak(args)
+    elif args.suite == "explain":
         result = run_explain(args)
     elif args.suite == "coldstart":
         result = run_coldstart(args)

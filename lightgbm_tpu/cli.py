@@ -333,6 +333,28 @@ def run_pipeline(cfg: Config):
     log_info(f"Finished pipeline ({len(results)} windows)")
 
 
+def run_soak(cfg: Config):
+    """Composed fleet chaos soak (docs/Soak.md): stand up the
+    scenario's M-tenant fleet, drive mixed-tenant load + per-tenant
+    retrains under the seed-keyed fault timeline, and print the
+    SLO-gated verdict JSON.  Exits nonzero when any gate fails."""
+    import json
+
+    from .soak import SoakScenario, run_and_report
+
+    sc = SoakScenario.from_config(cfg)
+    verdict = run_and_report(sc)
+    print(json.dumps(verdict, sort_keys=True, default=str))
+    if sc.out:
+        log_info(f"soak verdict written to {sc.out}")
+    if not verdict["ok"]:
+        raise LightGBMError(
+            "soak verdict FAILED: "
+            + ", ".join(name for name, g in verdict["gates"].items()
+                        if not g["ok"]))
+    log_info("Finished soak (verdict ok)")
+
+
 def run_warmup(cfg: Config):
     """Ahead-of-time compile warmup (docs/ColdStart.md): precompile the
     declared (rows, features, config) training + serving program
@@ -346,7 +368,7 @@ def run_warmup(cfg: Config):
 def main(argv=None):
     argv = list(argv if argv is not None else sys.argv[1:])
     # `lightgbm-tpu warmup|pipeline key=value...` subcommand sugar
-    if argv and argv[0] in ("warmup", "pipeline"):
+    if argv and argv[0] in ("warmup", "pipeline", "soak"):
         argv = argv[1:] + [f"task={argv[0]}"]
     # `--resume` sugar: continue a killed run from its last snapshot /
     # pipeline checkpoint (docs/Robustness.md)
@@ -378,6 +400,8 @@ def main(argv=None):
         run_warmup(cfg)
     elif task == "pipeline":
         run_pipeline(cfg)
+    elif task == "soak":
+        run_soak(cfg)
     else:
         raise LightGBMError(f"unknown task: {task}")
     return 0

@@ -553,6 +553,18 @@ def summary() -> Dict:
             "checkpoints": snap["counters"].get(
                 "pipeline.checkpoints", 0),
         }
+    if any(k.startswith("soak.") for k in snap["counters"]):
+        # a chaos soak ran (lightgbm_tpu/soak/): surface the injected
+        # chaos alongside the serving digest so a SOAK_r* bench line is
+        # self-describing without opening the full verdict
+        out["soak"] = {
+            "kills": snap["counters"].get("soak.kills", 0),
+            "resumes": snap["counters"].get("soak.resumes", 0),
+            "poison_sent": snap["counters"].get("soak.poison_sent", 0),
+            "dead_peer_timeouts": snap["counters"].get(
+                "soak.dead_peer_timeouts", 0),
+            "clock_skews": snap["counters"].get("soak.clock_skews", 0),
+        }
     if STATE.last_slo is not None:
         out["slo"] = STATE.last_slo.digest()
     exp = STATE.exporter

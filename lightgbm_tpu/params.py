@@ -88,7 +88,9 @@ PARAM_SCHEMA: Sequence[Param] = (
        desc="train, predict (prediction), convert_model, refit "
             "(refit_tree), warmup (AOT compile warmup into the "
             "persistent cache, docs/ColdStart.md), pipeline (windowed-"
-            "retrain pipeline over the data file, docs/Pipeline.md)",
+            "retrain pipeline over the data file, docs/Pipeline.md), "
+            "soak (composed fleet chaos soak to an SLO-gated verdict, "
+            "docs/Soak.md)",
        section="core"),
     _p("objective", str, "regression",
        ("objective_type", "app", "application"),
@@ -445,6 +447,65 @@ PARAM_SCHEMA: Sequence[Param] = (
             "persist; error=fault/oserror/timeout picks the raised "
             "flavor. Env override: LGBM_TPU_FAULTS. NEVER set in "
             "production", section="io"),
+    _p("soak_scenario", str, "", (),
+       desc="task=soak: path to a JSON SoakScenario file (docs/Soak.md) "
+            "overriding the individual soak_* params. Env override: "
+            "LGBM_TPU_SOAK=<path-or-inline-JSON> takes precedence over "
+            "everything", section="io"),
+    _p("soak_tenants", int, 2, (), check=">= 1",
+       desc="task=soak: cache nodes in the fleet — one FleetServer "
+            "tenant per node, each retrained through its own "
+            "RetrainPipeline (docs/Soak.md)", section="io"),
+    _p("soak_windows", int, 3, (), check=">= 1",
+       desc="task=soak: retrain windows per tenant (a tenant's cadence "
+            "subsamples these)", section="io"),
+    _p("soak_requests_per_window", int, 4096, (), check=">= 256",
+       desc="task=soak: synthetic cache-admission requests per window "
+            "(must be >= 2*soak_sample_rows so the labelable-row trim "
+            "keeps every window shape-stable)", section="io"),
+    _p("soak_sample_rows", int, 1024, (), check=">= 64",
+       desc="task=soak: training rows per window after the tail trim "
+            "(exact, so same-shape swaps stay zero-retrace)",
+       section="io"),
+    _p("soak_replicas", int, 1, (), check=">= 1",
+       desc="task=soak: fleet serving replicas", section="io"),
+    _p("soak_seed", int, 7, (),
+       desc="task=soak: the chaos seed — the fault timeline, traces and "
+            "sampling all derive from it, so the same seed replays the "
+            "same soak byte-for-byte (docs/Soak.md)", section="io"),
+    _p("soak_kills", int, 1, (), check=">= 0",
+       desc="task=soak: scheduled kill-and-resume points (a retrain "
+            "window's ingestion dies mid-window; the driver resumes "
+            "from the checkpoint and the verdict gates on byte-"
+            "identical reconvergence)", section="io"),
+    _p("soak_device_deaths", int, 0, (), check=">= 0",
+       desc="task=soak: transient device-death bursts injected on the "
+            "serving dispatch path (host fallback + breaker recovery; "
+            "dark time is charged to the availability objective)",
+       section="io"),
+    _p("soak_poison_batches", int, 1, (), check=">= 0",
+       desc="task=soak: malformed query micro-batches the fleet must "
+            "isolate per-request", section="io"),
+    _p("soak_dead_peers", int, 1, (), check=">= 0",
+       desc="task=soak: dead-ingest-peer timeouts on the query-load "
+            "feed (soak.load site)", section="io"),
+    _p("soak_clock_skews", int, 1, (), check=">= 0",
+       desc="task=soak: clock faults injected at the driver's SLO "
+            "clock stamps (soak.clock site; max 2 — run start and "
+            "verdict)", section="io"),
+    _p("soak_slo", str, "", (),
+       desc="task=soak: SLO spec the verdict evaluates (obs/slo.py "
+            "grammar); empty uses the scenario default "
+            "'availability>=0.999,p95_ms<=250,burn<=14;"
+            "source=serve.fleet;window_s=600'", section="io"),
+    _p("soak_checkpoint_dir", str, "", (),
+       desc="task=soak: working directory for per-tenant pipeline "
+            "checkpoints + the telemetry stream; empty uses a fresh "
+            "temp dir", section="io"),
+    _p("soak_out", str, "", (),
+       desc="task=soak: write the verdict JSON here (SOAK_r*.json "
+            "rounds wrap it with the bench round envelope); empty "
+            "prints to stdout only", section="io"),
 
     # -- objective --------------------------------------------------------
     _p("num_class", int, 1, ("num_classes",), check="> 0",

@@ -69,6 +69,10 @@ KNOWN_SITES = (
     "net.connect", "net.send", "net.recv", "net.broadcast",
     "io.read", "io.write",
     "stream.parse", "obs.export", "ckpt.ack",
+    # soak harness process-level chaos (lightgbm_tpu/soak, docs/Soak.md):
+    # kill-and-resume at a scheduled retrain window's ingestion, dead
+    # ingest peer on the query-load feed, clock skew at an SLO stamp
+    "soak.kill", "soak.load", "soak.clock",
 )
 
 

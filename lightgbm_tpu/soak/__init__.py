@@ -1,0 +1,22 @@
+"""Composed N-node CDN-fleet chaos soak, gated on the SLO engine.
+
+The subsystem that runs the fork's pieces *together* at production
+shape (ROADMAP item 4): an M-tenant ``FleetServer`` retrained per
+tenant through ``RetrainPipeline`` under a deterministic seed-keyed
+fault timeline, with the verdict gated on ``obs/slo.py`` plus
+harness-level invariants (resume byte-identity, zero-retrace swaps,
+throughput vs the committed reference).  See docs/Soak.md.
+"""
+
+from .scenario import (FaultEvent, SoakScenario, compile_timeline,
+                       fault_spec, timeline_digest)
+from .driver import SoakDriver, run_scenario
+from .report import (build_verdict, run_and_report, strip_volatile,
+                     write_verdict)
+
+__all__ = [
+    "FaultEvent", "SoakScenario", "SoakDriver",
+    "build_verdict", "compile_timeline", "fault_spec",
+    "run_and_report", "run_scenario", "strip_volatile",
+    "timeline_digest", "write_verdict",
+]

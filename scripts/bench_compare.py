@@ -12,14 +12,18 @@ Usage::
 
     python scripts/bench_compare.py OLD.json NEW.json
     python scripts/bench_compare.py --latest          # in-repo rounds:
-        # newest BENCH_r*.json vs the previous parseable one
+        # per round FAMILY (BENCH_r*, MULTICHIP_r*, SOAK_r*, ...),
+        # the newest round vs that family's previous parseable one
     python scripts/bench_compare.py --self-test       # CI sanity
 
-Prints one JSON report line (``regressions`` / ``improvements`` /
-``unchanged`` + the obs digests of both runs when present) and exits
-nonzero iff any metric regressed past the threshold — CI runs
-``--latest`` so a committed round that silently loses >10% on a
-headline metric fails the build instead of being archaeology.
+Prints one JSON report line per compared pair (``regressions`` /
+``improvements`` / ``unchanged`` + the obs digests of both runs when
+present) and exits nonzero iff any metric regressed past the
+threshold — CI runs ``--latest`` so a committed round that silently
+loses >10% on a headline metric fails the build instead of being
+archaeology.  Rounds only ever diff against their own family; a
+global ordering would pair BENCH_r06 with MULTICHIP_r05 (different
+suites = false regressions).
 """
 
 from __future__ import annotations
@@ -135,20 +139,52 @@ def compare(old: dict, new: dict, threshold: float) -> dict:
     }
 
 
-def _round_key(path: str):
-    m = re.search(r"_r(\d+)", os.path.basename(path))
-    return (int(m.group(1)) if m else -1, path)
+#: a committed round file: <FAMILY>_r<N>.json (BENCH_r06.json,
+#: MULTICHIP_r05.json, SOAK_r01.json, ...).  Anything else in the glob
+#: (BASELINE.json, BENCH_local_r4_preview.json's family
+#: "BENCH_local") forms its own family or none, so it can never anchor
+#: a cross-family diff
+_ROUND_RE = re.compile(r"^([A-Za-z][A-Za-z0-9]*(?:_[A-Za-z0-9]+)*?)"
+                       r"_r(\d+)\.json$")
 
 
-def latest_pair(pattern: str):
-    """The newest round file vs the previous PARSEABLE one (rounds
-    whose ``parsed`` is null — crashed runs — can't anchor a diff)."""
-    paths = sorted(glob.glob(pattern), key=_round_key)
-    usable = [p for p in paths
-              if extract_metrics(json.load(open(p)))]
-    if len(usable) < 2:
+def _family_round(path: str):
+    """(family, round#) of a round file, or None when the name doesn't
+    follow the <FAMILY>_r<N>.json convention."""
+    m = _ROUND_RE.match(os.path.basename(path))
+    if not m:
         return None
-    return usable[-2], usable[-1]
+    return m.group(1), int(m.group(2))
+
+
+def _round_key(path: str):
+    fr = _family_round(path)
+    return (fr[1] if fr else -1, path)
+
+
+def latest_pairs(pattern: str):
+    """Per-family newest pairs: group the glob's matches by their
+    ``<FAMILY>_r<N>`` family prefix, and within EACH family return the
+    newest round vs the previous PARSEABLE one (rounds whose
+    ``parsed`` is null — crashed runs — can't anchor a diff).
+    -> sorted [(family, old_path, new_path)].
+
+    A single global ordering would interleave families (BENCH_r06 "vs"
+    MULTICHIP_r05 diffs different suites = false regressions, and a
+    young family like SOAK_r* would never pair at all)."""
+    groups = {}
+    for p in glob.glob(pattern):
+        fr = _family_round(p)
+        if fr is None:
+            continue
+        groups.setdefault(fr[0], []).append(p)
+    pairs = []
+    for fam in sorted(groups):
+        usable = [p for p in sorted(groups[fam], key=_round_key)
+                  if extract_metrics(json.load(open(p)))]
+        if len(usable) >= 2:
+            pairs.append((fam, usable[-2], usable[-1]))
+    return pairs
 
 
 def self_test() -> int:
@@ -183,6 +219,41 @@ def self_test() -> int:
     assert "value" not in m and "legs.f32.ms_per_tree" in m, m
     rep = compare({"metric": "m", "value": 200.0, "unit": "s"}, cp, 0.10)
     assert rep["compared"] == 0, rep
+    # --latest groups rounds per family: each family pairs its own two
+    # newest parseable rounds, never a cross-family diff, and files
+    # outside the <FAMILY>_r<N>.json convention are ignored
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        def w(name, doc):
+            with open(os.path.join(td, name), "w") as fh:
+                json.dump(doc, fh)
+        good = {"parsed": {"ms_per_tree": 50.0}}
+        w("BENCH_r01.json", good)
+        w("BENCH_r02.json", {"parsed": {"ms_per_tree": 52.0}})
+        w("BENCH_r03.json", {"parsed": None, "rc": 1})  # crashed
+        w("MULTICHIP_r01.json", good)
+        w("MULTICHIP_r04.json", {"parsed": {"ms_per_tree": 49.0}})
+        w("SOAK_r01.json", good)                  # young family: 1 round
+        w("BASELINE.json", good)                  # not a round file
+        w("BENCH_local_r4_preview.json", good)    # not <FAM>_r<N>.json
+        pairs = latest_pairs(os.path.join(td, "*_r*.json"))
+        assert [(f, os.path.basename(a), os.path.basename(b))
+                for f, a, b in pairs] == [
+            ("BENCH", "BENCH_r01.json", "BENCH_r02.json"),
+            ("MULTICHIP", "MULTICHIP_r01.json", "MULTICHIP_r04.json"),
+        ], pairs
+        # numeric round ordering, not lexicographic
+        w("MULTICHIP_r10.json", {"parsed": {"ms_per_tree": 48.0}})
+        pairs = dict((f, (os.path.basename(a), os.path.basename(b)))
+                     for f, a, b in latest_pairs(
+                         os.path.join(td, "*_r*.json")))
+        assert pairs["MULTICHIP"] == ("MULTICHIP_r04.json",
+                                      "MULTICHIP_r10.json"), pairs
+        # a second soak round makes the family pair up
+        w("SOAK_r02.json", {"parsed": {"ms_per_tree": 51.0}})
+        fams = [f for f, _, _ in latest_pairs(
+            os.path.join(td, "*_r*.json"))]
+        assert fams == ["BENCH", "MULTICHIP", "SOAK"], fams
     print("bench_compare self-test OK")
     return 0
 
@@ -193,10 +264,13 @@ def main() -> int:
                     help="OLD.json NEW.json (bench.py output or "
                          "committed BENCH_r*.json round wrappers)")
     ap.add_argument("--latest", action="store_true",
-                    help="compare the two newest parseable rounds "
-                         "matching --glob in the repo root")
-    ap.add_argument("--glob", default="BENCH_r*.json",
-                    help="round pattern for --latest")
+                    help="for EACH round family matching --glob in the "
+                         "repo root (BENCH_r*/MULTICHIP_r*/SOAK_r*/...)"
+                         ", compare its two newest parseable rounds; "
+                         "one report line per family")
+    ap.add_argument("--glob", default="*_r*.json",
+                    help="round pattern for --latest (matches are "
+                         "grouped per <FAMILY>_r<N> family)")
     ap.add_argument("--threshold", type=float, default=0.10,
                     help="relative worsening that counts as a "
                          "regression (default 0.10 = 10%%)")
@@ -206,13 +280,27 @@ def main() -> int:
         return self_test()
     if args.latest:
         here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        pair = latest_pair(os.path.join(here, args.glob))
-        if pair is None:
-            print(json.dumps({"skipped": "fewer than two parseable "
-                                         "rounds", "glob": args.glob}))
+        pairs = latest_pairs(os.path.join(here, args.glob))
+        if not pairs:
+            print(json.dumps({"skipped": "no round family has two "
+                                         "parseable rounds",
+                              "glob": args.glob}))
             return 0
-        old_path, new_path = pair
-    elif len(args.files) == 2:
+        rc = 0
+        for fam, old_path, new_path in pairs:
+            with open(old_path) as fh:
+                old = json.load(fh)
+            with open(new_path) as fh:
+                new = json.load(fh)
+            report = compare(old, new, args.threshold)
+            report["family"] = fam
+            report["old_file"] = os.path.basename(old_path)
+            report["new_file"] = os.path.basename(new_path)
+            print(json.dumps(report))
+            if report["regressions"]:
+                rc = 1
+        return rc
+    if len(args.files) == 2:
         old_path, new_path = args.files
     else:
         ap.error("need OLD.json NEW.json, --latest, or --self-test")

@@ -160,6 +160,15 @@ if [[ "${1:-}" != "--fast" ]]; then
     #     coordinator (docs/Sharding.md "Multi-host pod slices")
     step "multihost smoke" python scripts/check_multihost.py
 
+    # 5g. soak smoke: the composed fleet chaos soak (2 tenants x 3
+    #     windows x 1 injected mid-window kill + poison batch + dead
+    #     ingest peer + clock skew) must reach a PASS verdict on CPU:
+    #     availability >= 99.9% through the kill, byte-identical
+    #     resume, zero-retrace swaps after window 0, zero dropped
+    #     export lines, and a same-seed replay reproducing the
+    #     timeline digest (docs/Soak.md)
+    step "soak smoke" python scripts/check_soak.py
+
     tier1() {
         rm -f /tmp/_t1.log
         timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ \

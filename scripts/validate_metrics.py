@@ -7,6 +7,7 @@ Usage::
     python scripts/validate_metrics.py --stream s.jsonl # exporter stream
     python scripts/validate_metrics.py --prom m.prom    # exposition file
     python scripts/validate_metrics.py --trace t.json   # span links
+    python scripts/validate_metrics.py --soak v.json    # soak verdict
 
 Exit 0 when the document is schema-valid, 1 with one error per line
 otherwise.  Also importable: ``validate(doc)`` /
@@ -278,6 +279,118 @@ def validate_stream_line(doc: Dict) -> List[str]:
     return errors
 
 
+SOAK_SCHEMA_NAME = "lightgbm-tpu-soak"
+SOAK_SCHEMA_VERSION = 1
+_SOAK_GATES = ("availability", "slo", "completed",
+               "resume_byte_identity", "zero_retrace_swaps",
+               "chaos_fired", "export", "throughput")
+_SOAK_EVENT_KINDS = {"kill", "device_death", "poison", "dead_peer",
+                     "clock_skew"}
+
+
+def _validate_slo_report(slo) -> List[str]:
+    """The FULL ``SloReport.to_json()`` (objectives as a LIST of
+    SloResult objects — the compact digest's objectives are a dict,
+    which :func:`_validate_slo_digest` covers)."""
+    errors: List[str] = []
+    err = errors.append
+    if not isinstance(slo, dict):
+        return ["slo is not an object"]
+    if not isinstance(slo.get("ok"), bool):
+        err("slo.ok missing or not a bool")
+    if not _num(slo.get("window_s")):
+        err("slo.window_s missing or not a number")
+    objectives = slo.get("objectives")
+    if not isinstance(objectives, list) or not objectives:
+        err("slo.objectives missing or not a non-empty list")
+        return errors
+    for o in objectives:
+        if not isinstance(o, dict) or not o.get("name"):
+            err("slo objective is not an object with a name")
+            continue
+        name = o["name"]
+        if not isinstance(o.get("ok"), bool):
+            err(f"slo objective {name!r}.ok missing or not a bool")
+        if not o.get("comparator"):
+            err(f"slo objective {name!r} missing comparator")
+        if not _num(o.get("target")):
+            err(f"slo objective {name!r}.target is not a number")
+        if o.get("observed") is not None and not _num(o["observed"]):
+            err(f"slo objective {name!r}.observed is neither null "
+                f"nor a number")
+    if (isinstance(slo.get("ok"), bool) and slo["ok"]
+            and any(isinstance(o, dict) and o.get("ok") is False
+                    for o in objectives)):
+        err("slo.ok is true but an objective failed")
+    return errors
+
+
+def validate_soak(doc: Dict) -> List[str]:
+    """Schema of a soak verdict (``--soak``; docs/Soak.md): the round's
+    ``SOAK_r*.json`` wraps this under ``parsed``."""
+    if not isinstance(doc, dict):
+        return ["soak verdict is not a JSON object"]
+    errors: List[str] = []
+    err = errors.append
+    if doc.get("schema") != SOAK_SCHEMA_NAME:
+        err(f"soak schema != {SOAK_SCHEMA_NAME!r}: "
+            f"{doc.get('schema')!r}")
+    if doc.get("schema_version") != SOAK_SCHEMA_VERSION:
+        err(f"soak schema_version != {SOAK_SCHEMA_VERSION}: "
+            f"{doc.get('schema_version')!r}")
+    if not isinstance(doc.get("ok"), bool):
+        err("soak ok missing or not a bool")
+    if not isinstance(doc.get("chip_pending"), bool):
+        err("soak chip_pending missing or not a bool "
+            "(the honesty flag is mandatory)")
+    sc = doc.get("scenario")
+    if not isinstance(sc, dict):
+        err("soak scenario missing or not an object")
+    else:
+        for k in ("tenants", "windows", "seed"):
+            if not _num(sc.get(k)):
+                err(f"soak scenario.{k} missing or not a number")
+    if not isinstance(doc.get("fault_spec"), str):
+        err("soak fault_spec missing or not a string")
+    digest = doc.get("timeline_digest")
+    if not (isinstance(digest, str)
+            and re.fullmatch(r"[0-9a-f]{64}", digest)):
+        err("soak timeline_digest is not a sha256 hex digest")
+    timeline = doc.get("timeline")
+    if not isinstance(timeline, list):
+        err("soak timeline missing or not a list")
+    else:
+        for i, e in enumerate(timeline):
+            if not isinstance(e, dict) \
+                    or e.get("kind") not in _SOAK_EVENT_KINDS:
+                err(f"soak timeline[{i}] has no known event kind")
+    errors.extend(f"soak {e}"
+                  for e in _validate_slo_report(doc.get("slo")))
+    gates = doc.get("gates")
+    if not isinstance(gates, dict):
+        err("soak gates missing or not an object")
+    else:
+        for name in _SOAK_GATES:
+            g = gates.get(name)
+            if not isinstance(g, dict) \
+                    or not isinstance(g.get("ok"), bool):
+                err(f"soak gate {name!r} missing or without a bool ok")
+        if (isinstance(doc.get("ok"), bool) and doc["ok"]
+                and any(isinstance(g, dict) and g.get("ok") is False
+                        for g in gates.values())):
+            err("soak ok is true but a gate failed")
+        thr = gates.get("throughput")
+        if isinstance(thr, dict):
+            v = thr.get("train_s_per_1M_sampled_rows")
+            if v is not None and not _num(v):
+                err("soak throughput.train_s_per_1M_sampled_rows is "
+                    "neither null nor a number")
+            if not _num(thr.get("reference_s_per_1M")):
+                err("soak throughput.reference_s_per_1M is not a "
+                    "number")
+    return errors
+
+
 _PROM_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _PROM_SAMPLE = re.compile(
     r"^(?P<name>[^\s{]+)(?P<labels>\{[^}]*\})?\s+(?P<value>\S+)"
@@ -540,6 +653,78 @@ _SELF_TEST_CASES = [
     ("slo non-bool ok", ("slo", "ok"), "yes", "slo.ok"),
 ]
 
+def _good_soak_doc() -> Dict:
+    """A minimal valid soak verdict (the docs/Soak.md schema)."""
+    gates = {name: {"ok": True} for name in _SOAK_GATES}
+    gates["throughput"].update(
+        {"train_s_per_1M_sampled_rows": 2500.0,
+         "reference_s_per_1M": 6.27, "chip_pending": True})
+    return {
+        "schema": SOAK_SCHEMA_NAME,
+        "schema_version": SOAK_SCHEMA_VERSION,
+        "scenario": {"tenants": 2, "windows": 3, "seed": 7},
+        "fault_spec": "soak.kill:n=1,soak.clock:after=1:n=1",
+        "timeline": [
+            {"kind": "kill", "tenant": 0, "window": 1, "at": 0,
+             "site": "soak.kill"},
+            {"kind": "clock_skew", "at": 1, "site": "soak.clock"},
+        ],
+        "timeline_digest": "ab" * 32,
+        "slo": {
+            "spec": "availability>=0.999;source=serve.fleet",
+            "source": "serve.fleet", "window_s": 600.0,
+            "evaluated_unix": 1700000000.0, "ok": True,
+            "objectives": [
+                {"name": "availability", "comparator": ">=",
+                 "target": 0.999, "observed": 1.0, "ok": True},
+                {"name": "p95_ms", "comparator": "<=",
+                 "target": 250.0, "observed": 12.5, "ok": True},
+            ],
+            "counts": {"ok": 700, "fallback": 0, "failed": 0,
+                       "input_errors": 8, "dark_fraction": 0.0,
+                       "availability": 1.0},
+        },
+        "gates": gates,
+        "ok": True,
+        "chip_pending": True,
+    }
+
+
+#: (description, mutation path, bad value, substring the error must
+#: carry) — planted defects validate_soak must catch
+_SOAK_SELF_TEST_CASES = [
+    ("wrong soak schema", ("schema",), "other", "schema"),
+    ("wrong soak schema version", ("schema_version",), 99,
+     "schema_version"),
+    ("missing chip_pending honesty flag", ("chip_pending",), _DELETE,
+     "chip_pending"),
+    ("non-bool verdict ok", ("ok",), "yes", "ok missing or not"),
+    ("scenario dropped", ("scenario",), _DELETE, "scenario"),
+    ("scenario without tenants", ("scenario", "tenants"), _DELETE,
+     "tenants"),
+    ("fault_spec dropped", ("fault_spec",), _DELETE, "fault_spec"),
+    ("timeline digest not sha256", ("timeline_digest",), "xyz",
+     "sha256"),
+    ("timeline event with unknown kind", ("timeline", 0, "kind"),
+     "meteor", "event kind"),
+    ("slo objectives as dict (digest form, not full report)",
+     ("slo", "objectives"), {}, "objectives"),
+    ("slo objective missing comparator",
+     ("slo", "objectives", 0, "comparator"), _DELETE, "comparator"),
+    ("slo ok contradicts objective",
+     ("slo", "objectives", 0, "ok"), False, "objective failed"),
+    ("gate dropped", ("gates", "resume_byte_identity"), _DELETE,
+     "resume_byte_identity"),
+    ("gate without bool ok", ("gates", "export", "ok"), "fine",
+     "export"),
+    ("verdict ok contradicts a gate",
+     ("gates", "availability", "ok"), False, "gate failed"),
+    ("throughput reference dropped",
+     ("gates", "throughput", "reference_s_per_1M"), _DELETE,
+     "reference_s_per_1M"),
+]
+
+
 def _good_trace() -> Dict:
     """A chrome trace with one causal chain (root -> window -> swap)
     plus a serve span linking back to the swap."""
@@ -665,6 +850,18 @@ def self_test() -> int:
             failures.append(
                 f"planted trace defect {desc!r} caught with unexpected "
                 f"message(s): {errs}")
+    # the soak-verdict validator checks itself the same way
+    errs = validate_soak(_good_soak_doc())
+    if errs:
+        failures.append(f"good soak verdict rejected: {errs}")
+    for desc, path, value, needle in _SOAK_SELF_TEST_CASES:
+        errs = validate_soak(_mutate(_good_soak_doc(), path, value))
+        if not errs:
+            failures.append(f"planted soak defect not caught: {desc}")
+        elif not any(needle in e for e in errs):
+            failures.append(
+                f"planted soak defect {desc!r} caught with unexpected "
+                f"message(s): {errs}")
     errs = validate_prometheus(_GOOD_PROM)
     if errs:
         failures.append(f"good exposition rejected: {errs}")
@@ -682,7 +879,8 @@ def self_test() -> int:
             print(f"SELF-TEST FAIL: {f}", file=sys.stderr)
         return 1
     n = (len(_SELF_TEST_CASES) + len(_PROM_SELF_TEST_CASES)
-         + len(_TRACE_SELF_TEST_CASES) + 10)
+         + len(_TRACE_SELF_TEST_CASES) + len(_SOAK_SELF_TEST_CASES)
+         + 11)
     print(f"OK: validator self-test passed ({n} cases)")
     return 0
 
@@ -713,6 +911,23 @@ def main(argv=None) -> int:
             print(f"INVALID: {e}", file=sys.stderr)
         if not errors:
             print(f"OK: {argv[1]} span links intact ({n_ev} events)")
+        return 1 if errors else 0
+    if len(argv) == 2 and argv[0] == "--soak":
+        with open(argv[1]) as fh:
+            doc = json.load(fh)
+        # accept the raw verdict, the committed round wrapper, and the
+        # bench.py --suite soak result (verdict nested under "soak")
+        if "parsed" in doc and "schema" not in doc:
+            doc = doc["parsed"] or {}
+        if "soak" in doc and "schema" not in doc:
+            doc = doc["soak"] or {}
+        errors = validate_soak(doc)
+        for e in errors:
+            print(f"INVALID: {e}", file=sys.stderr)
+        if not errors:
+            gates = ",".join(sorted(doc.get("gates", {})))
+            print(f"OK: {argv[1]} is a schema-valid soak verdict "
+                  f"(ok={doc.get('ok')}, gates={gates})")
         return 1 if errors else 0
     if len(argv) == 2 and argv[0] == "--stream":
         errors = []

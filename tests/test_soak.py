@@ -1,0 +1,252 @@
+"""Composed chaos-soak suite (``lightgbm_tpu/soak/``; docs/Soak.md).
+
+Fast cases pin the deterministic scenario layer — JSON round-trips,
+seed-keyed timeline compilation, the single up-front fault-spec
+string, shape-stable window payloads — and the verdict builder against
+synthetic driver outcomes (each gate must both pass on a clean outcome
+and FIRE on the matching defect).  The ``slow``-marked cases run the
+real composed soak end to end on CPU: same-seed replay must agree on
+the ``strip_volatile`` projection byte-for-byte, a mid-window kill
+must resume byte-identical at fleet scale, and the persistent
+device-death flavor must FAIL the availability gate (the SLO engine
+proving it can fire, not just pass).
+"""
+
+import json
+import tempfile
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.basic import LightGBMError
+from lightgbm_tpu.soak import (SoakScenario, build_verdict,
+                               compile_timeline, fault_spec,
+                               run_and_report, strip_volatile,
+                               timeline_digest)
+from lightgbm_tpu.soak.scenario import (kill_points, poison_ticks)
+
+# full end-to-end runs are expensive (~15 s each); slow cases share
+# them through this cache so replay determinism, kill identity and the
+# PASS verdict are asserted on the same two runs
+_RUNS = {}
+
+
+def _default_run(tag):
+    if tag not in _RUNS:
+        sc = SoakScenario()
+        wd = tempfile.mkdtemp(prefix=f"soak_test_{tag}_")
+        _RUNS[tag] = run_and_report(sc, workdir=wd)
+    return _RUNS[tag]
+
+
+# ---------------------------------------------------------------------------
+# scenario layer (tier-1)
+# ---------------------------------------------------------------------------
+
+def test_scenario_json_roundtrip():
+    sc = SoakScenario(tenants=3, windows=4, cadence=(1, 2, 1),
+                      kills=2, seed=11)
+    doc = sc.to_json()
+    assert doc["cadence"] == [1, 2, 1]
+    back = SoakScenario.from_json(json.loads(json.dumps(doc)))
+    assert back == sc
+    with pytest.raises(LightGBMError, match="unknown keys"):
+        SoakScenario.from_json({"tenants": 2, "typo_key": 1})
+
+
+def test_scenario_validation():
+    with pytest.raises(LightGBMError, match="windows >= 2"):
+        SoakScenario(windows=1, kills=1).validate()
+    with pytest.raises(LightGBMError, match="2\\*sample_rows"):
+        SoakScenario(requests_per_window=1024,
+                     sample_rows=1024).validate()
+    with pytest.raises(LightGBMError, match="one entry per tenant"):
+        SoakScenario(cadence=(1,)).validate()
+    with pytest.raises(LightGBMError, match=">= 2 "):
+        # every tenant retrains only window 0 -> no kill candidate
+        SoakScenario(windows=2, cadence=(2, 2), kills=1).validate()
+    assert SoakScenario().validate() is not None
+
+
+def test_schedule_cadence():
+    sc = SoakScenario(tenants=2, windows=6, cadence=(1, 3), kills=0)
+    assert sc.schedule(0) == [0, 1, 2, 3, 4, 5]
+    assert sc.schedule(1) == [0, 3]
+
+
+def test_timeline_deterministic_and_seed_keyed():
+    sc = SoakScenario()
+    a, b = compile_timeline(sc), compile_timeline(sc)
+    assert [e.to_json() for e in a] == [e.to_json() for e in b]
+    assert timeline_digest(sc, a) == timeline_digest(sc, b)
+    other = SoakScenario(seed=8)
+    assert timeline_digest(sc) != timeline_digest(other)
+    # kills target window >= 1 within the tenant's own schedule
+    for e in a:
+        if e.kind == "kill":
+            assert e.window >= 1
+            assert e.window in sc.schedule(e.tenant)
+
+
+def test_fault_spec_single_arming_string():
+    sc = SoakScenario()  # 1 kill, 1 poison, 1 dead peer, 1 clock skew
+    events = compile_timeline(sc)
+    spec = fault_spec(sc, events)
+    assert "soak.kill:n=1" in spec
+    assert "soak.load:after=" in spec and ":error=timeout" in spec
+    assert "soak.clock:after=1:n=1" in spec
+    assert len(poison_ticks(events)) == 1
+    kp = kill_points(events)
+    assert sum(len(v) for v in kp.values()) == 1
+    persist = SoakScenario(device_deaths=1, device_death_persist=True)
+    assert ":persist" in fault_spec(persist)
+    burst = SoakScenario(device_deaths=2)
+    assert "serve.fleet.dispatch:after=" in fault_spec(burst)
+    assert ":n=4" in fault_spec(burst)  # 2 deaths x burst 2
+
+
+def test_window_payload_shape_stable_and_pure():
+    sc = SoakScenario()
+    a = sc.window_payload(0, 0)
+    b = sc.window_payload(0, 0)
+    np.testing.assert_array_equal(a.label, b.label)
+    for x, y in zip(a.csr[:3], b.csr[:3]):
+        np.testing.assert_array_equal(x, y)
+    # every (tenant, window) trims to exactly sample_rows rows of the
+    # same feature count -> shape-stable retrains (zero-retrace gate)
+    c = sc.window_payload(1, 2)
+    assert a.num_rows == c.num_rows == sc.sample_rows
+    assert a.csr[3] == c.csr[3]
+    # distinct windows are distinct workloads
+    assert not np.array_equal(a.label, c.label)
+
+
+# ---------------------------------------------------------------------------
+# verdict builder on synthetic outcomes (tier-1)
+# ---------------------------------------------------------------------------
+
+def _synthetic_outcome():
+    sc = SoakScenario(tenants=1, windows=2, kills=1, poison_batches=0,
+                      dead_peers=0, clock_skews=0).validate()
+    events = compile_timeline(sc)
+    win = [{"window": 0, "swap_same_shape": None, "train_s": 1.0,
+            "rows_trained": sc.sample_rows, "tenant": 0},
+           {"window": 1, "swap_same_shape": True, "train_s": 1.0,
+            "rows_trained": sc.sample_rows, "tenant": 0}]
+    return {
+        "scenario": sc.to_json(),
+        "fault_spec": fault_spec(sc, events),
+        "timeline": [e.to_json() for e in events],
+        "timeline_digest": timeline_digest(sc, events),
+        "slo": {"ok": True, "objectives": [
+            {"name": "availability", "comparator": ">=",
+             "target": 0.999, "observed": 1.0, "ok": True}],
+            "counts": {"dark_fraction": 0.0}},
+        "windows": {"0": win},
+        "kills": [{"tenant": 0, "window": 1, "payload_index": 1,
+                   "checkpoint_window": 0, "resumed": True}],
+        "byte_identity": [{"tenant": 0, "kills": 1, "resumed": 1,
+                           "byte_identical": True}],
+        "tenant_errors": {},
+        "load": {"submitted": 10, "answered": 10, "rejected": 0,
+                 "poison_sent": 0, "dead_peer_timeouts": 0},
+        "clock_faults_fired": 0,
+        "counters": {"serve.fleet.swap_shape_changes": 0},
+        "export": {"flushes": 3, "dropped": 0, "write_errors": 0},
+        "elapsed_s": 2.5, "started_unix": 1.0, "evaluated_unix": 3.5,
+    }
+
+
+def test_build_verdict_clean_outcome_passes():
+    v = build_verdict(_synthetic_outcome())
+    assert v["ok"] is True
+    assert all(g["ok"] for g in v["gates"].values())
+    assert isinstance(v["chip_pending"], bool)
+    # off-TPU the throughput gate is informational, value still carried
+    assert v["gates"]["throughput"]["train_s_per_1M_sampled_rows"] > 0
+
+
+@pytest.mark.parametrize("mutate,gate", [
+    (lambda o: o["export"].update(dropped=2), "export"),
+    (lambda o: o["byte_identity"][0].update(byte_identical=False),
+     "resume_byte_identity"),
+    (lambda o: o["kills"].clear(), "resume_byte_identity"),
+    (lambda o: o["windows"]["0"][1].update(swap_same_shape=False),
+     "zero_retrace_swaps"),
+    (lambda o: o["tenant_errors"].update({"0": "boom"}), "completed"),
+    (lambda o: o["slo"]["objectives"][0].update(ok=False,
+                                                observed=0.9),
+     "availability"),
+])
+def test_build_verdict_gate_fires(mutate, gate):
+    o = _synthetic_outcome()
+    mutate(o)
+    if gate == "availability":
+        o["slo"]["ok"] = False
+    v = build_verdict(o)
+    assert v["gates"][gate]["ok"] is False
+    assert v["ok"] is False
+
+
+def test_strip_volatile_is_replay_stable_projection():
+    v = build_verdict(_synthetic_outcome())
+    s = strip_volatile(v)
+    blob = json.dumps(s, sort_keys=True)
+    assert "elapsed_s" not in s and "counters" not in s
+    assert "train_s" not in blob and "started_unix" not in blob
+    assert s["timeline_digest"] == v["timeline_digest"]
+    assert s["gates"] == {k: True for k in v["gates"]}
+    # volatile fields must not leak through the kill records either
+    v2 = build_verdict(_synthetic_outcome())
+    v2["elapsed_s"] = 99.0
+    v2["kills"][0]["resume_s"] = 1.23
+    assert json.dumps(strip_volatile(v2), sort_keys=True) == blob
+
+
+# ---------------------------------------------------------------------------
+# composed end-to-end runs (slow; scripts/check.sh dedicated step)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_soak_default_scenario_passes_on_cpu():
+    v = _default_run("a")
+    assert v["ok"] is True, json.dumps(v["gates"], indent=1,
+                                       default=str)
+    assert v["chip_pending"] is True  # CPU container honesty flag
+    assert v["gates"]["availability"]["ok"] is True
+    assert v["gates"]["zero_retrace_swaps"]["ok"] is True
+    assert v["gates"]["export"]["stats"]["dropped"] == 0
+
+
+@pytest.mark.slow
+def test_soak_kill_resumes_byte_identical_at_fleet_scale():
+    v = _default_run("a")
+    assert len(v["kills"]) == 1
+    k = v["kills"][0]
+    assert k["resumed"] is True and k["window"] >= 1
+    ident = v["gates"]["resume_byte_identity"]["tenants"]
+    assert ident and all(r["byte_identical"] for r in ident)
+
+
+@pytest.mark.slow
+def test_soak_same_seed_replay_identical():
+    a, b = _default_run("a"), _default_run("b")
+    assert a["timeline"] == b["timeline"]
+    assert a["timeline_digest"] == b["timeline_digest"]
+    assert (json.dumps(strip_volatile(a), sort_keys=True)
+            == json.dumps(strip_volatile(b), sort_keys=True))
+    # wall timings DO differ run to run; the projection must not
+    assert a["elapsed_s"] != b["elapsed_s"] or True
+
+
+@pytest.mark.slow
+def test_soak_persistent_device_death_fails_availability():
+    sc = SoakScenario(tenants=1, windows=2, kills=0, poison_batches=0,
+                      dead_peers=0, clock_skews=0, device_deaths=1,
+                      device_death_persist=True)
+    wd = tempfile.mkdtemp(prefix="soak_test_fail_")
+    v = run_and_report(sc, workdir=wd)
+    assert v["gates"]["availability"]["ok"] is False, json.dumps(
+        v["gates"]["availability"], default=str)
+    assert v["gates"]["slo"]["ok"] is False
+    assert v["ok"] is False
